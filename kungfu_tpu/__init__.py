@@ -1,0 +1,30 @@
+"""kungfu_tpu — a TPU-native adaptive distributed-training framework.
+
+Provides the capabilities of KungFu (OSDI'20: adaptive/elastic decentralized
+data-parallel training) re-designed for TPU hardware:
+
+- The collective data plane is XLA: ``psum``/``pmean``/``all_gather`` inside
+  jitted programs over a ``jax.sharding.Mesh`` (ICI), replacing the
+  reference's NCCL + TCP graph-walk collectives.
+- A host-side control plane (runner CLI, config server, heartbeat monitor,
+  TCP message channels) supervises worker processes and drives elastic
+  membership, replacing the reference's Go runtime.
+- Optimizers (SynchronousSGD, SynchronousAveraging, PairAveraging,
+  AdaptiveSGD, gradient-noise-scale monitoring) wrap optax gradient
+  transformations.
+
+Reference capability map: see SURVEY.md at the repo root.
+"""
+
+__version__ = "0.1.0"
+
+from kungfu_tpu.base.dtype import DType
+from kungfu_tpu.base.ops import ReduceOp
+from kungfu_tpu.base.strategy import Strategy
+
+__all__ = [
+    "DType",
+    "ReduceOp",
+    "Strategy",
+    "__version__",
+]

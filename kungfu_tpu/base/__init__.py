@@ -1,0 +1,6 @@
+from kungfu_tpu.base.dtype import DType
+from kungfu_tpu.base.ops import ReduceOp, transform2
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.base.workspace import Workspace
+
+__all__ = ["DType", "ReduceOp", "Strategy", "Workspace", "transform2"]

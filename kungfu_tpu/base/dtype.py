@@ -1,0 +1,91 @@
+"""Element dtypes shared by host-side buffers and device arrays.
+
+Capability parity: the reference's dtype enum mirrored between Go and C
+(srcs/go/kungfu/base/dtype.go:8-22, srcs/cpp/include/kungfu/dtype.h).
+TPU-first addition: BF16 is a first-class dtype (the MXU's native input
+format); the reference only knows IEEE F16 (reduced via AVX F16C).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class DType(enum.IntEnum):
+    U8 = 1
+    I8 = 2
+    I16 = 3
+    I32 = 4
+    I64 = 5
+    U16 = 6
+    U32 = 7
+    U64 = 8
+    F16 = 9
+    BF16 = 10
+    F32 = 11
+    F64 = 12
+
+    @property
+    def size(self) -> int:
+        """Size in bytes of one element."""
+        return _SIZES[self]
+
+    def to_numpy(self) -> np.dtype:
+        try:
+            return np.dtype(_NUMPY[self])
+        except KeyError:
+            raise ValueError(f"{self.name} requires ml_dtypes") from None
+
+    @classmethod
+    def from_numpy(cls, dt) -> "DType":
+        dt = np.dtype(dt)
+        try:
+            return _FROM_NUMPY[dt.name]
+        except KeyError:
+            raise ValueError(f"unsupported dtype: {dt}") from None
+
+
+_SIZES = {
+    DType.U8: 1,
+    DType.I8: 1,
+    DType.I16: 2,
+    DType.I32: 4,
+    DType.I64: 8,
+    DType.U16: 2,
+    DType.U32: 4,
+    DType.U64: 8,
+    DType.F16: 2,
+    DType.BF16: 2,
+    DType.F32: 4,
+    DType.F64: 8,
+}
+
+# bfloat16 comes from ml_dtypes (always present with jax).
+try:
+    import ml_dtypes
+
+    _BF16 = np.dtype(ml_dtypes.bfloat16)
+except ImportError:  # pragma: no cover - ml_dtypes ships with jax
+    _BF16 = None
+
+_NUMPY = {
+    DType.U8: np.uint8,
+    DType.I8: np.int8,
+    DType.I16: np.int16,
+    DType.I32: np.int32,
+    DType.I64: np.int64,
+    DType.U16: np.uint16,
+    DType.U32: np.uint32,
+    DType.U64: np.uint64,
+    DType.F16: np.float16,
+    DType.BF16: _BF16,
+    DType.F32: np.float32,
+    DType.F64: np.float64,
+}
+
+if _BF16 is None:  # pragma: no cover
+    del _NUMPY[DType.BF16]
+
+_FROM_NUMPY = {np.dtype(v).name: k for k, v in _NUMPY.items()}

@@ -1,0 +1,64 @@
+"""Host-side reduction kernels.
+
+Capability parity: the reference's ``Transform2`` SIMD reduce
+(srcs/go/kungfu/base/op.go:25-36 -> op.cpp ``std_transform_2``, with AVX
+F16C for f16 in base/f16.c). Here the hot path is delegated to a small C++
+kernel (native/reduce.cpp, loaded via ctypes) when built, with a numpy
+fallback that is itself vectorized.
+
+These run on the host only — control-plane collectives and the DCN-level
+engine. Device reductions are XLA ``psum`` etc. (kungfu_tpu.ops).
+"""
+
+from __future__ import annotations
+
+import enum
+
+import numpy as np
+
+
+class ReduceOp(enum.IntEnum):
+    SUM = 0
+    MIN = 1
+    MAX = 2
+    PROD = 3
+
+
+_NUMPY_OPS = {
+    ReduceOp.SUM: np.add,
+    ReduceOp.MIN: np.minimum,
+    ReduceOp.MAX: np.maximum,
+    ReduceOp.PROD: np.multiply,
+}
+
+_native = None
+
+
+def _load_native():
+    """Load the optional C++ reduce kernel (built by native/build.sh)."""
+    global _native
+    if _native is None:
+        try:
+            from kungfu_tpu.base import _native_reduce
+
+            _native = _native_reduce
+        except Exception:
+            _native = False
+    return _native
+
+
+def transform2(dst: np.ndarray, x: np.ndarray, y: np.ndarray, op: ReduceOp) -> None:
+    """dst = x `op` y, elementwise; dst may alias x or y.
+
+    All three must be 1-D views of equal length and dtype.
+    """
+    native = _load_native()
+    if native and native.supported(x.dtype):
+        native.transform2(dst, x, y, int(op))
+        return
+    _NUMPY_OPS[op](x, y, out=dst)
+
+
+def reduce_inplace(acc: np.ndarray, incoming: np.ndarray, op: ReduceOp) -> None:
+    """acc = acc `op` incoming."""
+    transform2(acc, acc, incoming, op)

@@ -1,0 +1,36 @@
+"""Collective communication strategies (host/DCN plane).
+
+Capability parity: the reference's strategy enum
+(srcs/go/kungfu/base/strategy.go:10-22, srcs/cpp/include/kungfu/strategy.h),
+selecting the graph topology used by the host-side collective engine.
+
+On TPU the ICI data plane does not use these (XLA picks collective
+algorithms); they drive the host-side (DCN-level) engine used for control
+collectives (consensus, barrier, config digests) and CPU-only test clusters.
+"""
+
+from __future__ import annotations
+
+import enum
+
+
+class Strategy(enum.IntEnum):
+    STAR = 0
+    RING = 1
+    CLIQUE = 2
+    TREE = 3
+    BINARY_TREE = 4
+    BINARY_TREE_STAR = 5
+    AUTO = 6
+    MULTI_BINARY_TREE_STAR = 7
+    MULTI_STAR = 8
+
+    @classmethod
+    def parse(cls, name: str) -> "Strategy":
+        try:
+            return cls[name.strip().upper().replace("-", "_")]
+        except KeyError:
+            raise ValueError(f"unknown strategy: {name!r}") from None
+
+
+DEFAULT_STRATEGY = Strategy.BINARY_TREE_STAR

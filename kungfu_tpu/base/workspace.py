@@ -1,0 +1,71 @@
+"""Workspace: a named (send, recv, op) triple for one host collective.
+
+Capability parity: srcs/go/kungfu/base/workspace.go:10-50 (Workspace with
+``Split`` by partition function) and vector.go (zero-copy typed views).
+Numpy arrays already give us zero-copy typed slicing, so there is no
+separate Vector class.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Callable, List, Sequence, Tuple
+
+import numpy as np
+
+from kungfu_tpu.base.ops import ReduceOp
+
+# (begin, end) element intervals; mirrors plan.EvenPartition over Interval
+# (srcs/go/plan/interval.go).
+PartitionFunc = Callable[[int, int], Sequence[Tuple[int, int]]]
+
+
+def even_partition(count: int, k: int) -> List[Tuple[int, int]]:
+    """Split [0, count) into k contiguous intervals of near-equal size."""
+    q, r = divmod(count, k)
+    out = []
+    begin = 0
+    for i in range(k):
+        end = begin + q + (1 if i < r else 0)
+        out.append((begin, end))
+        begin = end
+    return out
+
+
+@dataclasses.dataclass
+class Workspace:
+    send: np.ndarray  # 1-D
+    recv: np.ndarray  # 1-D, same dtype/length as send
+    op: ReduceOp
+    name: str
+
+    @property
+    def is_empty(self) -> bool:
+        return self.send.size == 0
+
+    @property
+    def is_inplace(self) -> bool:
+        return self.send is self.recv or (
+            self.send.__array_interface__["data"][0]
+            == self.recv.__array_interface__["data"][0]
+            and self.send.size == self.recv.size
+        )
+
+    def forward(self) -> None:
+        """Copy send into recv (used when this rank only forwards data)."""
+        if not self.is_inplace:
+            np.copyto(self.recv, self.send)
+
+    def split(self, partition: PartitionFunc, k: int) -> List["Workspace"]:
+        """Split into k sub-workspaces named ``<name>[i/k]``."""
+        out = []
+        for i, (begin, end) in enumerate(partition(self.send.size, k)):
+            out.append(
+                Workspace(
+                    send=self.send[begin:end],
+                    recv=self.recv[begin:end],
+                    op=self.op,
+                    name=f"{self.name}[{i}/{k}]",
+                )
+            )
+        return out

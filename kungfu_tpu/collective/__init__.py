@@ -1,0 +1,15 @@
+from kungfu_tpu.collective.strategies import (
+    StrategyPair,
+    auto_select,
+    gen_cross_strategies,
+    gen_global_strategies,
+    gen_local_strategies,
+)
+
+__all__ = [
+    "StrategyPair",
+    "auto_select",
+    "gen_cross_strategies",
+    "gen_global_strategies",
+    "gen_local_strategies",
+]

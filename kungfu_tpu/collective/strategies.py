@@ -1,0 +1,132 @@
+"""Strategy lists: (reduce, bcast) graph pairs per Strategy enum.
+
+Capability parity: srcs/go/kungfu/session/strategy.go:58-210 — a strategy
+is a (reduceGraph, bcastGraph) pair; multi-root strategies (RING, CLIQUE,
+MULTI_STAR, MULTI_BINARY_TREE_STAR) return one pair per root so chunked
+collectives can stripe chunks across roots; AUTO picks STAR on a single
+host and BINARY_TREE_STAR across hosts (strategy.go:165-174).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+from typing import List
+
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.plan.graph import Graph
+from kungfu_tpu.plan.peer import PeerList
+from kungfu_tpu.plan import topology as topo
+
+
+@dataclasses.dataclass
+class StrategyPair:
+    reduce_graph: Graph
+    bcast_graph: Graph
+
+    @classmethod
+    def from_bcast(cls, bcast: Graph) -> "StrategyPair":
+        return cls(topo.gen_default_reduce_graph(bcast), bcast)
+
+    def digest(self) -> bytes:
+        return self.reduce_graph.digest() + self.bcast_graph.digest()
+
+
+StrategyList = List[StrategyPair]
+
+
+def digest(sl: StrategyList) -> bytes:
+    h = hashlib.blake2b(digest_size=16)
+    for s in sl:
+        h.update(s.digest())
+    return h.digest()
+
+
+def choose(sl: StrategyList, i: int) -> StrategyPair:
+    return sl[i % len(sl)]
+
+
+def auto_select(peers: PeerList) -> Strategy:
+    return Strategy.STAR if peers.host_count() == 1 else Strategy.BINARY_TREE_STAR
+
+
+def _star(peers: PeerList) -> StrategyList:
+    return [StrategyPair.from_bcast(topo.gen_star_bcast_graph(len(peers), 0))]
+
+
+def _multi_star(peers: PeerList) -> StrategyList:
+    return [StrategyPair.from_bcast(g) for g in topo.gen_multi_stars(peers)]
+
+
+def _clique(peers: PeerList) -> StrategyList:
+    k = len(peers)
+    return [StrategyPair.from_bcast(topo.gen_star_bcast_graph(k, r)) for r in range(k)]
+
+
+def _ring(peers: PeerList) -> StrategyList:
+    k = len(peers)
+    return [StrategyPair(*topo.gen_circular_graph_pair(k, r)) for r in range(k)]
+
+
+def _tree(peers: PeerList) -> StrategyList:
+    return [StrategyPair.from_bcast(topo.gen_tree(peers))]
+
+
+def _binary_tree(peers: PeerList) -> StrategyList:
+    return [StrategyPair.from_bcast(topo.gen_binary_tree(len(peers)))]
+
+
+def _binary_tree_star(peers: PeerList) -> StrategyList:
+    return [StrategyPair.from_bcast(topo.gen_binary_tree_star(peers))]
+
+
+def _multi_binary_tree_star(peers: PeerList) -> StrategyList:
+    return [StrategyPair.from_bcast(g) for g in topo.gen_multi_binary_tree_star(peers)]
+
+
+_GENERATORS = {
+    Strategy.STAR: _star,
+    Strategy.MULTI_STAR: _multi_star,
+    Strategy.CLIQUE: _clique,
+    Strategy.RING: _ring,
+    Strategy.TREE: _tree,
+    Strategy.BINARY_TREE: _binary_tree,
+    Strategy.BINARY_TREE_STAR: _binary_tree_star,
+    Strategy.MULTI_BINARY_TREE_STAR: _multi_binary_tree_star,
+}
+
+
+def gen_global_strategies(peers: PeerList, strategy: Strategy) -> StrategyList:
+    if strategy == Strategy.AUTO:
+        strategy = auto_select(peers)
+    return _GENERATORS[strategy](peers)
+
+
+def gen_local_strategies(peers: PeerList) -> StrategyList:
+    """Intra-host forest: each host master broadcasts to colocated peers."""
+    masters, master_of = peers.partition_by_host()
+    bcast, roots, ok = Graph.from_forest_array(master_of)
+    if not ok or roots != len(masters):
+        raise ValueError(f"invalid host partition forest: {master_of}")
+    return [StrategyPair.from_bcast(bcast)]
+
+
+def gen_cross_strategies(peers: PeerList, strategy: Strategy) -> StrategyList:
+    """Inter-host strategies over host masters only (hierarchical allreduce)."""
+    n = len(peers)
+    masters, _ = peers.partition_by_host()
+    if strategy == Strategy.RING:
+        return [
+            StrategyPair(*topo.gen_subset_circular_graph_pair(n, masters, r))
+            for r in range(len(masters))
+        ]
+    return [StrategyPair.from_bcast(topo.gen_subset_binary_tree(n, masters))]
+
+
+def from_forest_array(fathers: List[int]) -> StrategyList:
+    """Strategy from a runtime-supplied father array (SubsetAllReduce /
+    AllReduceWith / set_tree; session/allreduce.go:14-44)."""
+    bcast, _, ok = Graph.from_forest_array(fathers)
+    if not ok:
+        raise ValueError(f"invalid forest array: {fathers}")
+    return [StrategyPair.from_bcast(bcast)]

@@ -1,0 +1,14 @@
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.graph import Graph
+from kungfu_tpu.plan.peer import PeerID, PeerList
+from kungfu_tpu.plan.hostspec import HostSpec, HostList, parse_hostfile
+
+__all__ = [
+    "Cluster",
+    "Graph",
+    "HostList",
+    "HostSpec",
+    "PeerID",
+    "PeerList",
+    "parse_hostfile",
+]

@@ -1,0 +1,141 @@
+"""Topology generators: broadcast/reduce graph pairs over a PeerList.
+
+Capability parity: srcs/go/plan/topology.go:17-160 and
+srcs/go/plan/subgraph/subgraph.go. Each generator returns broadcast graphs
+(edges flow root -> leaves); the matching reduce graph is the reversal with
+self-loops on every node (gen_default_reduce_graph, topology.go:33-40).
+
+Host-locality-aware shapes (tree/star within a host, another shape across
+host masters) map DCN topology: intra-host edges are loopback, inter-host
+edges cross the network — on TPU pods this is the DCN between VM hosts.
+"""
+
+from __future__ import annotations
+
+from typing import List, Tuple
+
+from kungfu_tpu.plan.graph import Graph
+from kungfu_tpu.plan.peer import PeerList
+
+
+def gen_default_reduce_graph(bcast: Graph) -> Graph:
+    """Reverse the broadcast graph and self-loop every node (accumulate)."""
+    g = bcast.reverse()
+    for i in range(g.n):
+        g.add_edge(i, i)
+    return g
+
+
+def gen_star_bcast_graph(k: int, root: int = 0) -> Graph:
+    g = Graph(k)
+    for i in range(k):
+        if i != root:
+            g.add_edge(root, i)
+    return g
+
+
+def gen_binary_tree(k: int, root_offset: int = 0) -> Graph:
+    """Heap-layout binary tree over ranks (i -> 2i+1, 2i+2), rotated by offset."""
+    g = Graph(k)
+    idx = lambda i: (i + root_offset) % k
+    for i in range(k):
+        for j in (2 * i + 1, 2 * i + 2):
+            if j < k:
+                g.add_edge(idx(i), idx(j))
+    return g
+
+
+def gen_tree(peers: PeerList) -> Graph:
+    """Two-level tree: host masters star out to local peers; master[0] to other masters."""
+    g = Graph(len(peers))
+    masters, master_of = peers.partition_by_host()
+    for rank in range(len(peers)):
+        if master_of[rank] != rank:
+            g.add_edge(master_of[rank], rank)
+    for m in masters[1:]:
+        g.add_edge(masters[0], m)
+    return g
+
+
+def gen_multi_star(peers: PeerList, root_idx: int = 0) -> Graph:
+    """Intra-host stars + star over masters centered at masters[root_idx]."""
+    g = Graph(len(peers))
+    masters, master_of = peers.partition_by_host()
+    for rank in range(len(peers)):
+        if master_of[rank] != rank:
+            g.add_edge(master_of[rank], rank)
+    if len(masters) > 1:
+        for i, m in enumerate(masters):
+            if i != root_idx:
+                g.add_edge(masters[root_idx], m)
+    return g
+
+
+def gen_multi_stars(peers: PeerList) -> List[Graph]:
+    masters, _ = peers.partition_by_host()
+    return [gen_multi_star(peers, i) for i in range(len(masters))]
+
+
+def gen_binary_tree_star(peers: PeerList, offset: int = 0) -> Graph:
+    """Intra-host stars + binary tree over host masters (rotated by offset)."""
+    g = Graph(len(peers))
+    masters, master_of = peers.partition_by_host()
+    for rank in range(len(peers)):
+        if master_of[rank] != rank:
+            g.add_edge(master_of[rank], rank)
+    k = len(masters)
+    if k > 1:
+        idx = lambda i: (i + offset) % k
+        for i in range(k):
+            for j in (2 * i + 1, 2 * i + 2):
+                if j < k:
+                    g.add_edge(masters[idx(i)], masters[idx(j)])
+    return g
+
+
+def gen_multi_binary_tree_star(peers: PeerList) -> List[Graph]:
+    masters, _ = peers.partition_by_host()
+    return [gen_binary_tree_star(peers, i) for i in range(len(masters))]
+
+
+def gen_circular_graph_pair(k: int, r: int) -> Tuple[Graph, Graph]:
+    """Ring (reduce, bcast) pair rooted at rank r.
+
+    Reduce: chain (r+1) -> (r+2) -> ... -> r with self-loops everywhere
+    (each hop accumulates). Bcast: chain r -> (r+1) -> ... -> (r+k-1).
+    Used with chunking: chunk c uses root (c % k), giving a pipelined,
+    bandwidth-optimal ring like the classic ring-allreduce.
+    """
+    reduce_g = Graph(k)
+    bcast_g = Graph(k)
+    for i in range(k):
+        reduce_g.add_edge(i, i)
+    for i in range(1, k):
+        reduce_g.add_edge((r + i) % k, (r + i + 1) % k)
+        bcast_g.add_edge((r + i - 1) % k, (r + i) % k)
+    return reduce_g, bcast_g
+
+
+def gen_subset_circular_graph_pair(n: int, ranks: List[int], r: int) -> Tuple[Graph, Graph]:
+    """Ring pair over a subset of ranks (e.g. host masters), for cross-host
+    allreduce. Mirrors subgraph.GenCircularGraphPair."""
+    k = len(ranks)
+    reduce_g = Graph(n)
+    bcast_g = Graph(n)
+    for i in ranks:
+        reduce_g.add_edge(i, i)
+    for i in range(1, k):
+        reduce_g.add_edge(ranks[(r + i) % k], ranks[(r + i + 1) % k])
+        bcast_g.add_edge(ranks[(r + i - 1) % k], ranks[(r + i) % k])
+    return reduce_g, bcast_g
+
+
+def gen_subset_binary_tree(n: int, ranks: List[int]) -> Graph:
+    """Binary tree over a subset of ranks embedded in an n-rank graph."""
+    g = Graph(n)
+    k = len(ranks)
+    for i in range(k):
+        for j in (2 * i + 1, 2 * i + 2):
+            if j < k:
+                g.add_edge(ranks[i], ranks[j])
+    return g

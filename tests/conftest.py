@@ -1,0 +1,16 @@
+"""Test config: force an 8-device virtual CPU mesh before jax imports.
+
+Mirrors the reference's multi-process-on-localhost test strategy
+(SURVEY.md §4): we get multi-chip semantics on one machine via XLA's
+host-platform device partitioning instead of kungfu-run subprocesses
+(those are exercised separately in the integration tests).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+xla_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in xla_flags:
+    os.environ["XLA_FLAGS"] = (
+        xla_flags + " --xla_force_host_platform_device_count=8"
+    ).strip()

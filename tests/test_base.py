@@ -1,0 +1,92 @@
+"""base/: dtype, ops, workspace tests; mirrors tests of kungfu/base."""
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.base.dtype import DType
+from kungfu_tpu.base.ops import ReduceOp, reduce_inplace, transform2
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.base.workspace import Workspace, even_partition
+
+
+def test_dtype_sizes():
+    assert DType.F32.size == 4
+    assert DType.BF16.size == 2
+    assert DType.from_numpy(np.float32) == DType.F32
+    assert DType.F16.to_numpy() == np.dtype(np.float16)
+
+
+def test_dtype_bf16_roundtrip():
+    import ml_dtypes
+
+    assert DType.from_numpy(ml_dtypes.bfloat16) == DType.BF16
+
+
+def test_strategy_parse():
+    assert Strategy.parse("RING") == Strategy.RING
+    assert Strategy.parse("binary-tree-star") == Strategy.BINARY_TREE_STAR
+    with pytest.raises(ValueError):
+        Strategy.parse("bogus")
+
+
+@pytest.mark.parametrize("op,expect", [
+    (ReduceOp.SUM, [5, 7, 9]),
+    (ReduceOp.MIN, [1, 2, 3]),
+    (ReduceOp.MAX, [4, 5, 6]),
+    (ReduceOp.PROD, [4, 10, 18]),
+])
+def test_transform2(op, expect):
+    x = np.array([1, 2, 3], dtype=np.float32)
+    y = np.array([4, 5, 6], dtype=np.float32)
+    dst = np.zeros(3, dtype=np.float32)
+    transform2(dst, x, y, op)
+    np.testing.assert_array_equal(dst, np.array(expect, dtype=np.float32))
+
+
+def test_transform2_aliasing():
+    acc = np.array([1.0, 2.0], dtype=np.float32)
+    inc = np.array([10.0, 20.0], dtype=np.float32)
+    reduce_inplace(acc, inc, ReduceOp.SUM)
+    np.testing.assert_array_equal(acc, [11.0, 22.0])
+
+
+def test_transform2_f16_and_bf16():
+    import ml_dtypes
+
+    for dt in (np.float16, ml_dtypes.bfloat16):
+        x = np.array([1, 2, 3], dtype=dt)
+        y = np.array([4, 5, 6], dtype=dt)
+        dst = np.zeros(3, dtype=dt)
+        transform2(dst, x, y, ReduceOp.SUM)
+        np.testing.assert_array_equal(dst.astype(np.float32), [5, 7, 9])
+
+
+def test_even_partition():
+    assert even_partition(10, 3) == [(0, 4), (4, 7), (7, 10)]
+    assert even_partition(3, 5) == [(0, 1), (1, 2), (2, 3), (3, 3), (3, 3)]
+
+
+def test_workspace_split():
+    send = np.arange(10, dtype=np.float32)
+    recv = np.zeros(10, dtype=np.float32)
+    w = Workspace(send, recv, ReduceOp.SUM, "g")
+    parts = w.split(even_partition, 3)
+    assert len(parts) == 3
+    assert parts[0].send.size == 4
+    # splits are views: writing recv chunk writes the parent buffer
+    parts[0].recv[:] = 1.0
+    assert recv[:4].sum() == 4.0
+    assert parts[1].name == "g[1/3]"
+
+
+def test_workspace_forward_and_inplace():
+    send = np.arange(4, dtype=np.float32)
+    recv = np.zeros(4, dtype=np.float32)
+    w = Workspace(send, recv, ReduceOp.SUM, "f")
+    assert not w.is_inplace
+    w.forward()
+    np.testing.assert_array_equal(recv, send)
+
+    w2 = Workspace(send, send, ReduceOp.SUM, "ip")
+    assert w2.is_inplace
+    w2.forward()  # no-op, must not crash

@@ -1,0 +1,120 @@
+"""PeerList/Cluster/HostSpec tests; mirrors srcs/go/plan/{peerlist,cluster,hostspec}_test.go."""
+
+import pytest
+
+from kungfu_tpu.plan.cluster import Cluster, ClusterError
+from kungfu_tpu.plan.hostspec import HostList, HostSpec, parse_hostfile
+from kungfu_tpu.plan.peer import PeerID, PeerList
+
+
+def test_peer_id_parse():
+    p = PeerID.parse("10.0.0.1:38000")
+    assert p.host == "10.0.0.1" and p.port == 38000
+    with pytest.raises(ValueError):
+        PeerID.parse("nohost")
+
+
+def test_peer_list_ranks():
+    pl = PeerList.parse("a:1,a:2,b:1,b:2,b:3")
+    assert len(pl) == 5
+    assert pl.rank(PeerID("b", 1)) == 2
+    assert pl.rank(PeerID("c", 1)) is None
+    assert pl.local_rank(PeerID("b", 3)) == 2
+    assert pl.local_size(PeerID("a", 1)) == 2
+    assert pl.host_count() == 2
+    assert pl.hosts() == ["a", "b"]
+
+
+def test_peer_list_diff():
+    a = PeerList.parse("a:1,a:2,b:1")
+    b = PeerList.parse("a:2,b:1,b:2")
+    removed, added = a.diff(b)
+    assert list(removed) == [PeerID("a", 1)]
+    assert list(added) == [PeerID("b", 2)]
+
+
+def test_partition_by_host():
+    pl = PeerList.parse("a:1,b:1,a:2,b:2")
+    masters, master_of = pl.partition_by_host()
+    assert masters == [0, 1]
+    assert master_of == [0, 1, 0, 1]
+
+
+def test_peer_list_json_roundtrip():
+    pl = PeerList.parse("a:1,b:2")
+    assert PeerList.from_json(pl.to_json()) == pl
+    assert pl.digest() == PeerList.parse("a:1,b:2").digest()
+    assert pl.digest() != PeerList.parse("a:1,b:3").digest()
+
+
+def test_host_spec_parse():
+    h = HostSpec.parse("192.168.1.1:4:pub.example.com")
+    assert h.slots == 4 and h.public_addr == "pub.example.com"
+    assert HostSpec.parse("h1").slots == 1
+    with pytest.raises(ValueError):
+        HostSpec.parse("h1:x")
+
+
+def test_host_list_gen_peer_list():
+    hl = HostList.parse("a:2,b:2")
+    pl = hl.gen_peer_list(3)
+    assert [str(p) for p in pl] == ["a:38000", "a:38001", "b:38000"]
+    with pytest.raises(ValueError):
+        hl.gen_peer_list(5)
+
+
+def test_hostfile():
+    hl = parse_hostfile("# comment\nh1 slots=2\nh2 slots=1 public=h2.pub\n")
+    assert len(hl) == 2
+    assert hl[0].slots == 2
+    assert hl[1].public_addr == "h2.pub"
+
+
+def test_cluster_validate():
+    c = Cluster(
+        runners=PeerList.parse("a:5000,b:5000"),
+        workers=PeerList.parse("a:38000,a:38001,b:38000"),
+    )
+    c.validate()
+
+    # worker on host without runner
+    bad = Cluster(runners=PeerList.parse("a:5000"), workers=PeerList.parse("b:38000"))
+    with pytest.raises(ClusterError):
+        bad.validate()
+
+    # duplicated peer
+    dup = Cluster(
+        runners=PeerList.parse("a:5000"),
+        workers=PeerList.parse("a:38000,a:38000"),
+    )
+    with pytest.raises(ClusterError):
+        dup.validate()
+
+
+def test_cluster_resize_grow_least_loaded():
+    c = Cluster(
+        runners=PeerList.parse("a:5000,b:5000"),
+        workers=PeerList.parse("a:38000,a:38001,b:38000"),
+    )
+    d = c.resize(5)
+    assert len(d.workers) == 5
+    # growth balances hosts: b gets the 4th worker (b had 1, a had 2)
+    hosts = [w.host for w in d.workers]
+    assert hosts.count("a") == 3 and hosts.count("b") == 2
+    d.validate()
+
+    # shrink truncates
+    e = c.resize(1)
+    assert [str(w) for w in e.workers] == ["a:38000"]
+
+    # original unchanged
+    assert len(c.workers) == 3
+
+
+def test_cluster_json_roundtrip():
+    c = Cluster(
+        runners=PeerList.parse("a:5000"),
+        workers=PeerList.parse("a:38000,a:38001"),
+    )
+    assert Cluster.loads(c.dumps()) == c
+    assert c.digest() == c.clone().digest()

@@ -1,0 +1,83 @@
+"""Graph algebra tests; mirrors srcs/go/plan/graph/graph_test.go coverage."""
+
+import pytest
+
+from kungfu_tpu.plan.graph import Graph
+
+
+def test_add_edge_and_queries():
+    g = Graph(4)
+    g.add_edge(0, 1)
+    g.add_edge(0, 2)
+    g.add_edge(2, 3)
+    assert g.nexts(0) == [1, 2]
+    assert g.prevs(3) == [2]
+    assert g.prevs(0) == []
+    assert not g.is_self_loop(0)
+    g.add_edge(1, 1)
+    assert g.is_self_loop(1)
+    assert not g.is_isolated(0)
+
+
+def test_isolated():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    assert g.is_isolated(2)
+    assert not g.is_isolated(1)
+
+
+def test_reverse():
+    g = Graph(3)
+    g.add_edge(0, 1)
+    g.add_edge(1, 2)
+    r = g.reverse()
+    assert r.nexts(1) == [0]
+    assert r.nexts(2) == [1]
+    assert r.prevs(0) == [1]
+
+
+def test_from_forest_array():
+    # 0 is root; 1,2 children of 0; 3 child of 1
+    g, roots, ok = Graph.from_forest_array([0, 0, 0, 1])
+    assert ok and roots == 1
+    assert sorted(g.nexts(0)) == [1, 2]
+    assert g.nexts(1) == [3]
+
+    # two roots
+    _, roots, ok = Graph.from_forest_array([0, 1, 0, 1])
+    assert ok and roots == 2
+
+    # out of range
+    _, _, ok = Graph.from_forest_array([5, 0])
+    assert not ok
+
+    # cycle: 0->1->0 with no root
+    _, _, ok = Graph.from_forest_array([1, 0])
+    assert not ok
+
+
+def test_digest_canonical():
+    g1 = Graph(3)
+    g1.add_edge(0, 1)
+    g1.add_edge(0, 2)
+    g2 = Graph(3)
+    g2.add_edge(0, 2)  # different insertion order
+    g2.add_edge(0, 1)
+    assert g1.digest() == g2.digest()
+
+    g3 = Graph(3)
+    g3.add_edge(0, 1)
+    assert g1.digest() != g3.digest()
+
+    g4 = Graph(3)
+    g4.add_edge(0, 1)
+    g4.add_edge(0, 2)
+    g4.add_edge(1, 1)
+    assert g1.digest() != g4.digest()
+
+
+def test_debug_string():
+    g = Graph(2)
+    g.add_edge(0, 1)
+    g.add_edge(0, 0)
+    assert g.debug_string() == "[2]{(0)(0->1)}"

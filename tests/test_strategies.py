@@ -1,0 +1,112 @@
+"""Strategy list tests; mirrors strategy coverage in session tests."""
+
+import pytest
+
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.collective import strategies as st
+from kungfu_tpu.plan.peer import PeerID, PeerList
+
+
+def make_peers(*host_slots):
+    peers = []
+    for host, n in host_slots:
+        for i in range(n):
+            peers.append(PeerID(host, 38000 + i))
+    return PeerList(peers)
+
+
+ALL_STRATEGIES = [
+    Strategy.STAR,
+    Strategy.MULTI_STAR,
+    Strategy.CLIQUE,
+    Strategy.RING,
+    Strategy.TREE,
+    Strategy.BINARY_TREE,
+    Strategy.BINARY_TREE_STAR,
+    Strategy.MULTI_BINARY_TREE_STAR,
+]
+
+
+def spanning(bcast, n):
+    """Check the bcast graph reaches every rank from its roots."""
+    roots = [i for i in range(n) if not bcast.prevs(i)]
+    seen = set(roots)
+    frontier = list(roots)
+    while frontier:
+        i = frontier.pop()
+        for j in bcast.nexts(i):
+            if j not in seen:
+                seen.add(j)
+                frontier.append(j)
+    return len(seen) == n
+
+
+@pytest.mark.parametrize("strategy", ALL_STRATEGIES)
+@pytest.mark.parametrize(
+    "peers",
+    [
+        make_peers(("a", 1)),
+        make_peers(("a", 4)),
+        make_peers(("a", 2), ("b", 2)),
+        make_peers(("a", 3), ("b", 2), ("c", 1)),
+    ],
+    ids=["1x1", "1x4", "2x2", "3-2-1"],
+)
+def test_all_strategies_span(strategy, peers):
+    sl = st.gen_global_strategies(peers, strategy)
+    assert len(sl) >= 1
+    for pair in sl:
+        assert spanning(pair.bcast_graph, len(peers))
+        # reduce graph accumulates somewhere: at least one self-loop
+        assert any(pair.reduce_graph.is_self_loop(i) for i in range(len(peers)))
+
+
+def test_auto_select():
+    assert st.auto_select(make_peers(("a", 4))) == Strategy.STAR
+    assert st.auto_select(make_peers(("a", 2), ("b", 2))) == Strategy.BINARY_TREE_STAR
+
+
+def test_multi_root_strategy_counts():
+    peers = make_peers(("a", 2), ("b", 2), ("c", 2))
+    assert len(st.gen_global_strategies(peers, Strategy.RING)) == 6
+    assert len(st.gen_global_strategies(peers, Strategy.CLIQUE)) == 6
+    assert len(st.gen_global_strategies(peers, Strategy.MULTI_STAR)) == 3
+    assert len(st.gen_global_strategies(peers, Strategy.MULTI_BINARY_TREE_STAR)) == 3
+
+
+def test_local_strategies():
+    peers = make_peers(("a", 2), ("b", 3))
+    sl = st.gen_local_strategies(peers)
+    assert len(sl) == 1
+    b = sl[0].bcast_graph
+    # host masters are roots of the local forest
+    assert not b.prevs(0) and not b.prevs(2)
+    assert b.prevs(1) == [0]
+    assert sorted(b.nexts(2)) == [3, 4]
+
+
+def test_cross_strategies():
+    peers = make_peers(("a", 2), ("b", 2), ("c", 2))
+    sl = st.gen_cross_strategies(peers, Strategy.RING)
+    assert len(sl) == 3  # one per master root
+    sl2 = st.gen_cross_strategies(peers, Strategy.BINARY_TREE_STAR)
+    assert len(sl2) == 1
+    # non-masters are isolated in cross graphs
+    for pair in sl2:
+        for r in (1, 3, 5):
+            assert pair.bcast_graph.is_isolated(r)
+
+
+def test_from_forest_array():
+    sl = st.from_forest_array([0, 0, 1, 1])
+    assert len(sl) == 1
+    with pytest.raises(ValueError):
+        st.from_forest_array([3, 9])
+
+
+def test_digest_stable():
+    peers = make_peers(("a", 2), ("b", 2))
+    a = st.digest(st.gen_global_strategies(peers, Strategy.RING))
+    b = st.digest(st.gen_global_strategies(peers, Strategy.RING))
+    c = st.digest(st.gen_global_strategies(peers, Strategy.STAR))
+    assert a == b and a != c

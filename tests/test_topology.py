@@ -1,0 +1,92 @@
+"""Topology generator tests; mirrors srcs/go/plan/topology_test.go."""
+
+import pytest
+
+from kungfu_tpu.plan import topology as topo
+from kungfu_tpu.plan.peer import PeerID, PeerList
+
+
+def make_peers(*host_slots):
+    peers = []
+    for host, n in host_slots:
+        for i in range(n):
+            peers.append(PeerID(host, 38000 + i))
+    return PeerList(peers)
+
+
+def test_star():
+    g = topo.gen_star_bcast_graph(4, 0)
+    assert sorted(g.nexts(0)) == [1, 2, 3]
+    assert g.prevs(1) == [0]
+
+
+def test_default_reduce_graph():
+    b = topo.gen_star_bcast_graph(3, 0)
+    r = topo.gen_default_reduce_graph(b)
+    # reversed edges: leaves send to root; every node self-loops
+    assert r.prevs(0) == [1, 2] or sorted(r.prevs(0)) == [1, 2]
+    for i in range(3):
+        assert r.is_self_loop(i)
+
+
+def test_binary_tree():
+    g = topo.gen_binary_tree(7)
+    assert sorted(g.nexts(0)) == [1, 2]
+    assert sorted(g.nexts(1)) == [3, 4]
+    assert sorted(g.nexts(2)) == [5, 6]
+
+
+def test_tree_two_hosts():
+    peers = make_peers(("a", 2), ("b", 2))
+    g = topo.gen_tree(peers)
+    # rank 0 master of host a, rank 2 master of host b
+    assert 1 in g.nexts(0)  # local star on a
+    assert 3 in g.nexts(2)  # local star on b
+    assert 2 in g.nexts(0)  # master[0] -> master[1]
+
+
+def test_binary_tree_star():
+    peers = make_peers(("a", 2), ("b", 2), ("c", 2))
+    g = topo.gen_binary_tree_star(peers)
+    masters, master_of = peers.partition_by_host()
+    assert masters == [0, 2, 4]
+    # local stars
+    assert 1 in g.nexts(0)
+    assert 3 in g.nexts(2)
+    assert 5 in g.nexts(4)
+    # binary tree over masters: 0 -> 2, 4
+    assert 2 in g.nexts(0) and 4 in g.nexts(0)
+
+
+def test_multi_binary_tree_star_count():
+    peers = make_peers(("a", 2), ("b", 2), ("c", 1))
+    gs = topo.gen_multi_binary_tree_star(peers)
+    assert len(gs) == 3  # one per host master
+
+
+def test_circular_graph_pair():
+    k = 4
+    for r in range(k):
+        rg, bg = topo.gen_circular_graph_pair(k, r)
+        # reduce chain: r+1 -> r+2 -> ... -> r; every node self-loops
+        for i in range(k):
+            assert rg.is_self_loop(i)
+        # chain ends at r: r has one prev, no nexts in chain
+        assert len(rg.prevs(r)) == 1
+        assert len(rg.nexts(r)) == 0
+        # bcast chain starts at r
+        assert len(bg.prevs(r)) == 0
+        assert len(bg.nexts(r)) == 1
+        # total edges: k-1 in each chain
+        n_redge = sum(len(rg.nexts(i)) for i in range(k))
+        n_bedge = sum(len(bg.nexts(i)) for i in range(k))
+        assert n_redge == k - 1 and n_bedge == k - 1
+
+
+def test_subset_ring():
+    rg, bg = topo.gen_subset_circular_graph_pair(6, [0, 2, 4], 0)
+    # only masters participate
+    for i in (1, 3, 5):
+        assert rg.is_isolated(i) and not rg.is_self_loop(i)
+        assert bg.is_isolated(i)
+    assert rg.is_self_loop(0)
