@@ -1,0 +1,84 @@
+"""Benchmark: ResNet-50 training throughput (images/sec/chip) on TPU.
+
+Prints ONE JSON line:
+  {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+Baseline: the reference's headline workload is ResNet-50 synchronous SGD
+(README "Benchmark", 16x V100). Published-era per-GPU throughput for
+TF ResNet-50 fp32 on V100 is ~350 images/sec (the regime of the
+reference's charts, benchmarks/system/result/sync-scalability.svg);
+vs_baseline = our images/sec/chip / 350.
+"""
+
+from __future__ import annotations
+
+import json
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+BASELINE_IMG_PER_SEC = 350.0  # TF ResNet-50 fp32 on V100, reference era
+
+
+def main() -> None:
+    from kungfu_tpu.models.resnet import init_resnet, resnet50, resnet_loss
+
+    batch = 128
+    image_size = 224
+    model = resnet50(num_classes=1000)
+    key = jax.random.PRNGKey(0)
+    params, batch_stats = init_resnet(key, model, image_size, batch=2)
+
+    opt = optax.sgd(0.1, momentum=0.9)
+    opt_state = opt.init(params)
+
+    images = jax.random.normal(key, (batch, image_size, image_size, 3), jnp.float32)
+    labels = jnp.zeros((batch,), jnp.int32)
+
+    @jax.jit
+    def step(params, batch_stats, opt_state, batch_data):
+        def loss_fn(p):
+            return resnet_loss(model, p, batch_stats, batch_data)
+
+        (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        updates, opt_state2 = opt.update(grads, opt_state, params)
+        params = optax.apply_updates(params, updates)
+        return params, new_stats, opt_state2, loss
+
+    # warmup/compile; device_get forces real completion (block_until_ready
+    # does not block on the axon tunnel backend)
+    for _ in range(3):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, (images, labels)
+        )
+    float(jax.device_get(loss))
+
+    iters = 20
+    t0 = time.perf_counter()
+    for _ in range(iters):
+        params, batch_stats, opt_state, loss = step(
+            params, batch_stats, opt_state, (images, labels)
+        )
+    float(jax.device_get(loss))
+    dt = time.perf_counter() - t0
+
+    img_per_sec = batch * iters / dt
+    n_chips = jax.device_count()
+    per_chip = img_per_sec / n_chips
+    print(
+        json.dumps(
+            {
+                "metric": "resnet50_train_throughput_per_chip",
+                "value": round(per_chip, 2),
+                "unit": "images/sec/chip",
+                "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC, 3),
+            }
+        )
+    )
+
+
+if __name__ == "__main__":
+    main()

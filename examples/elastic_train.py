@@ -1,0 +1,74 @@
+"""Elastic training example: resizable MLP training with ElasticState.
+
+Parity: /root/reference/examples (elastic estimator examples) — run:
+
+  kfrun -np 2 -H 127.0.0.1:4 -w -builtin-config-port 9100 \\
+      python examples/elastic_train.py
+
+then grow/shrink the cluster from another terminal:
+
+  curl -X PUT -d '{"Runners": ["127.0.0.1:38080"], "Workers": \\
+      ["127.0.0.1:38000","127.0.0.1:38001","127.0.0.1:38002"]}' \\
+      http://127.0.0.1:9100/config
+
+Workers re-sync progress via int-max allreduce and keep training; removed
+workers detach and exit. (Host/DCN plane only — single-chip compute per
+worker. On a TPU pod, pair this with reload-mode restarts so each epoch
+gets a fresh ICI mesh.)
+"""
+
+import argparse
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kungfu_tpu import api
+from kungfu_tpu.elastic.state import ElasticState
+from kungfu_tpu.models.mlp import init_mlp, mlp_loss
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--batch", type=int, default=64)
+    args = p.parse_args()
+
+    rank = api.current_rank()
+    params = init_mlp(jax.random.PRNGKey(0))
+    opt = optax.sgd(0.1)
+    state = opt.init(params)
+
+    @jax.jit
+    def local_step(params, state, batch):
+        loss, grads = jax.value_and_grad(mlp_loss)(params, batch)
+        updates, state = opt.update(grads, state, params)
+        return optax.apply_updates(params, updates), state, loss
+
+    rng = np.random.default_rng(rank)
+    es = ElasticState(max_progress=args.steps)
+    while not es.stopped():
+        with es.scope():
+            x = jnp.asarray(rng.normal(size=(args.batch, 784)), jnp.float32)
+            y = jnp.asarray(rng.integers(0, 10, args.batch))
+            params, state, loss = local_step(params, state, (x, y))
+            # average the models across the (possibly just-resized) cluster
+            flat = np.concatenate(
+                [np.ravel(np.asarray(l, np.float32)) for l in jax.tree.leaves(params)]
+            )
+            avg = api.all_reduce_array(flat, name="model-avg") / api.cluster_size()
+            leaves = jax.tree.leaves(params)
+            out, off = [], 0
+            for l in leaves:
+                out.append(jnp.asarray(avg[off:off + l.size].reshape(l.shape)))
+                off += l.size
+            params = jax.tree.unflatten(jax.tree.structure(params), out)
+            if rank == 0 and es.progress % 20 == 0:
+                print(f"step {es.progress}: loss {float(loss):.4f} np={api.cluster_size()}")
+            es.end(1)
+    print(f"rank {rank}: {es.stop_reason} at progress {es.progress}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,111 @@
+"""User-facing process API.
+
+Capability parity: srcs/python/kungfu/python/__init__.py:17-168 —
+current_rank/cluster_size/local metadata, barrier, resize/propose,
+all_reduce helpers — backed by the in-process Peer singleton instead of
+ctypes into libkungfu.
+"""
+
+from __future__ import annotations
+
+import atexit
+from typing import Optional, Sequence
+
+import numpy as np
+
+from kungfu_tpu.base.ops import ReduceOp
+from kungfu_tpu.base.workspace import Workspace
+from kungfu_tpu.peer import finalize_default_peer, get_default_peer
+
+atexit.register(finalize_default_peer)
+
+
+def current_rank() -> int:
+    return get_default_peer().rank
+
+
+def cluster_size() -> int:
+    return get_default_peer().size
+
+
+def current_local_rank() -> int:
+    return get_default_peer().current_session().local_rank
+
+
+def current_local_size() -> int:
+    return get_default_peer().current_session().local_size
+
+
+def host_count() -> int:
+    return get_default_peer().current_session().host_count
+
+
+def current_cluster_version() -> int:
+    return get_default_peer().cluster_version
+
+
+def uid() -> int:
+    """(version, rank) packed; parity: python/__init__.py uid."""
+    p = get_default_peer()
+    return (p.cluster_version << 16) | p.rank
+
+
+def detached() -> bool:
+    return get_default_peer().detached
+
+
+def run_barrier() -> None:
+    get_default_peer().current_session().barrier()
+
+
+def all_reduce_array(
+    x: np.ndarray, op: ReduceOp = ReduceOp.SUM, name: str = "user"
+) -> np.ndarray:
+    """Host-plane allreduce of a numpy array (control data, NOT gradients —
+    those belong on the ICI plane via kungfu_tpu.ops)."""
+    flat = np.ascontiguousarray(x).reshape(-1)
+    out = np.zeros_like(flat)
+    w = Workspace(send=flat, recv=out, op=op, name=f"kungfu::user::{name}")
+    get_default_peer().current_session().all_reduce(w)
+    return out.reshape(x.shape)
+
+
+def all_reduce_int_max(x: int) -> int:
+    out = all_reduce_array(np.array([x], np.int64), ReduceOp.MAX, "int-max")
+    return int(out[0])
+
+
+def consensus(data: bytes, name: str = "user") -> bool:
+    return get_default_peer().current_session().bytes_consensus(data, name)
+
+
+def resize(new_size: Optional[int] = None):
+    """Resize the cluster; returns (changed, detached).
+
+    With new_size=None, pulls the desired cluster from the config server
+    (parity: resize_cluster_from_url); otherwise grows/shrinks to new_size.
+    """
+    p = get_default_peer()
+    if new_size is None:
+        return p.resize_cluster_from_url()
+    return p.resize_cluster(new_size)
+
+
+def propose_new_size(new_size: int) -> None:
+    get_default_peer().propose_new_size(new_size)
+
+
+def change_cluster(progress: int):
+    return get_default_peer().change_cluster(progress)
+
+
+def save(name: str, data: bytes) -> None:
+    """Publish a blob to this peer's store (parity: SaveVariable)."""
+    get_default_peer().p2p.save(name, data)
+
+
+def request(rank: int, name: str) -> Optional[bytes]:
+    """Fetch a blob from peer `rank`'s store (parity: RequestVariable)."""
+    p = get_default_peer()
+    sess = p.current_session()
+    return p.p2p.request(sess.peers[rank], name)

@@ -1,0 +1,52 @@
+"""ctypes loader for the native reduce kernel (native/reduce.cpp).
+
+Exposes supported(dtype) and transform2(dst, x, y, op) used by
+kungfu_tpu.base.ops; absent or failed builds fall back to numpy there.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+
+import numpy as np
+
+from kungfu_tpu.base.dtype import DType
+
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "libkfnative.so")
+
+if not os.path.exists(_LIB_PATH):
+    raise ImportError(f"native kernel not built: {_LIB_PATH}")
+
+_lib = ctypes.CDLL(_LIB_PATH)
+_lib.kf_transform2.restype = ctypes.c_int
+_lib.kf_transform2.argtypes = [
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_void_p,
+    ctypes.c_int64,
+    ctypes.c_int32,
+    ctypes.c_int32,
+]
+
+
+def supported(dtype) -> bool:
+    try:
+        DType.from_numpy(dtype)
+        return True
+    except ValueError:
+        return False
+
+
+def _ptr(a: np.ndarray):
+    return a.ctypes.data_as(ctypes.c_void_p) if a.flags["C_CONTIGUOUS"] else None
+
+
+def transform2(dst: np.ndarray, x: np.ndarray, y: np.ndarray, op: int) -> None:
+    dt = DType.from_numpy(dst.dtype)
+    pd, px, py = _ptr(dst), _ptr(x), _ptr(y)
+    if pd is None or px is None or py is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_transform2(pd, px, py, dst.size, int(dt), int(op))
+    if rc != 0:
+        raise ValueError(f"native transform2 unsupported: dtype={dt}, op={op}")

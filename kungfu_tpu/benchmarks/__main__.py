@@ -1,0 +1,89 @@
+"""Allreduce throughput benchmark.
+
+Capability parity: python -m kungfu.tensorflow.v1.benchmarks
+(srcs/python/kungfu/tensorflow/v1/benchmarks/__main__.py) — measure
+allreduce bus throughput over a fake model's gradient set and print
+``RESULT: <v> +-<e> (GiB/s)``. Methods:
+  XLA   — on-device psum over the local mesh (the ICI data plane)
+  HOST  — the host-side graph-walk engine (DCN plane; run under kfrun)
+"""
+
+from __future__ import annotations
+
+import argparse
+import time
+
+import numpy as np
+
+
+def bench_xla(model: str, iters: int, warmup: int = 3) -> None:
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import PartitionSpec as P
+
+    from kungfu_tpu.models.fake import FAKE_MODELS
+    from kungfu_tpu.ops.collective import group_all_reduce
+    from kungfu_tpu.parallel import make_mesh, DeviceSession
+
+    sizes = FAKE_MODELS[model]
+    sess = DeviceSession(make_mesh())
+    n = sess.size
+    xs = [jnp.ones((n, s), jnp.float32) for s in sizes]
+    fn = sess.spmd(
+        lambda t: group_all_reduce(t, sess.axis_names[0]),
+        in_specs=P(sess.axis_names[0]),
+        out_specs=P(),
+    )
+    for _ in range(warmup):
+        out = fn(xs)
+    float(jax.device_get(out[0][0, 0]))  # real sync (axon: block_until_ready lies)
+
+    samples = []
+    total_bytes = sum(s * 4 for s in sizes)
+    for _ in range(iters):
+        t0 = time.perf_counter()
+        out = fn(xs)
+        float(jax.device_get(out[-1][0, 0]))
+        dt = time.perf_counter() - t0
+        # algorithm bandwidth: 2(n-1)/n factors omitted — report bus data rate
+        samples.append(total_bytes / dt / (1 << 30))
+    mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
+    print(f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) [XLA x{n} devices, {model}]")
+
+
+def bench_host(model: str, iters: int) -> None:
+    from kungfu_tpu import api
+    from kungfu_tpu.models.fake import fake_gradients
+
+    grads = fake_gradients(model)
+    total_bytes = sum(g.nbytes for g in grads)
+    api.run_barrier()
+    samples = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        for j, g in enumerate(grads):
+            api.all_reduce_array(g, name=f"bench:{i}:{j}")
+        dt = time.perf_counter() - t0
+        samples.append(total_bytes / dt / (1 << 30))
+    mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
+    if api.current_rank() == 0:
+        print(
+            f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) "
+            f"[HOST x{api.cluster_size()} workers, {model}]"
+        )
+
+
+def main() -> None:
+    p = argparse.ArgumentParser("kungfu_tpu.benchmarks")
+    p.add_argument("--method", choices=["XLA", "HOST"], default="XLA")
+    p.add_argument("--model", default="resnet50-imagenet")
+    p.add_argument("--iters", type=int, default=10)
+    args = p.parse_args()
+    if args.method == "XLA":
+        bench_xla(args.model, args.iters)
+    else:
+        bench_host(args.model, args.iters)
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,283 @@
+"""Host-side collective engine: graph-walk collectives over the transport.
+
+Capability parity: srcs/go/kungfu/session/session.go — an immutable
+peer-list epoch running Barrier / Consensus / Reduce / Broadcast / Gather /
+AllReduce by walking (reduce, bcast) graph pairs, with 1 MiB chunking
+striped across multi-root strategies (runStrategies, session.go:301-330)
+and SIMD reduction on receive (base.Transform2).
+
+Role in the TPU build: this engine runs on HOSTS over DCN for control
+collectives (consensus on cluster configs, barriers, progress sync) and for
+CPU-only test clusters — the device data plane is XLA over ICI
+(kungfu_tpu.ops). It is the direct replacement for the reference's
+rchannel data plane.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Callable, List, Optional, Sequence
+
+import numpy as np
+
+from kungfu_tpu.base.ops import ReduceOp, reduce_inplace
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.base.workspace import Workspace, even_partition
+from kungfu_tpu.collective import strategies as st
+from kungfu_tpu.plan.graph import Graph
+from kungfu_tpu.plan.peer import PeerID, PeerList
+from kungfu_tpu.transport.client import Client
+from kungfu_tpu.transport.handlers import CollectiveEndpoint
+from kungfu_tpu.transport.message import ConnType, Flags
+
+CHUNK_BYTES = 1 << 20  # 1 MiB, parity: session.go chunkSize
+DEFAULT_TIMEOUT = 120.0
+
+
+def _par(fns: List[Callable[[], None]], timeout: float) -> None:
+    """Run callables in parallel threads, join, re-raise the first error
+    (goroutine-style fan-out; avoids pool-exhaustion deadlocks on nested
+    parallelism)."""
+    if not fns:
+        return
+    if len(fns) == 1:
+        fns[0]()
+        return
+    errs: List[BaseException] = []
+    lock = threading.Lock()
+
+    def run(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - propagated below
+            with lock:
+                errs.append(e)
+
+    threads = [threading.Thread(target=run, args=(fn,), daemon=True) for fn in fns]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout)
+        if t.is_alive():
+            raise TimeoutError("collective thread timed out")
+    if errs:
+        raise errs[0]
+
+
+
+class HostSession:
+    """One collective epoch over a fixed PeerList."""
+
+    def __init__(
+        self,
+        strategy: Strategy,
+        self_id: PeerID,
+        peers: PeerList,
+        client: Client,
+        endpoint: CollectiveEndpoint,
+        timeout: float = DEFAULT_TIMEOUT,
+    ):
+        rank = peers.rank(self_id)
+        if rank is None:
+            raise ValueError(f"{self_id} not in peer list {peers}")
+        self.self_id = self_id
+        self.peers = peers
+        self.rank = rank
+        self.local_rank = peers.local_rank(self_id)
+        self.local_size = peers.local_size(self_id)
+        self.host_count = peers.host_count()
+        self.client = client
+        self.endpoint = endpoint
+        self.timeout = timeout
+        if strategy == Strategy.AUTO:
+            strategy = st.auto_select(peers)
+        self.strategy = strategy
+        self.global_strategies = st.gen_global_strategies(peers, strategy)
+        self.local_strategies = st.gen_local_strategies(peers)
+        self.cross_strategies = st.gen_cross_strategies(peers, strategy)
+
+    @property
+    def size(self) -> int:
+        return len(self.peers)
+
+    def close(self) -> None:
+        pass
+
+    # ------------------------------------------------------------------
+    # public collectives
+    # ------------------------------------------------------------------
+
+    def all_reduce(self, w: Workspace) -> None:
+        self._run_strategies(w, self.global_strategies)
+
+    def cross_all_reduce(self, w: Workspace) -> None:
+        """AllReduce across host masters only (hierarchical path)."""
+        self._run_strategies(w, self.cross_strategies)
+
+    def local_reduce(self, w: Workspace) -> None:
+        self._run_graphs(w, [self.local_strategies[0].reduce_graph])
+
+    def local_broadcast(self, w: Workspace) -> None:
+        self._run_graphs(w, [self.local_strategies[0].bcast_graph])
+
+    def reduce(self, w: Workspace) -> None:
+        self._run_graphs(w, [self.global_strategies[0].reduce_graph])
+
+    def broadcast(self, w: Workspace) -> None:
+        self._run_graphs(w, [self.global_strategies[0].bcast_graph])
+
+    def subset_all_reduce(self, fathers: Sequence[int], w: Workspace) -> None:
+        sl = st.from_forest_array(list(fathers))
+        self._run_strategies(w, sl)
+
+    def all_reduce_with(self, fathers: Sequence[int], w: Workspace) -> None:
+        """AllReduce on a runtime-supplied tree (parity: AllReduceWith)."""
+        if fathers:
+            sl = st.from_forest_array(list(fathers))
+        else:
+            sl = self.global_strategies
+        self._run_strategies(w, sl)
+
+    def barrier(self, tag: str = "") -> None:
+        """Parity: session.go:98-113 (an allreduce of size bytes)."""
+        k = len(self.peers)
+        w = Workspace(
+            send=np.zeros(k, np.uint8),
+            recv=np.zeros(k, np.uint8),
+            op=ReduceOp.SUM,
+            name=f"kungfu::barrier{tag}",
+        )
+        self.all_reduce(w)
+
+    def bytes_consensus(self, bs: bytes, name: str) -> bool:
+        """True iff every peer supplied identical bytes (session.go:126-157):
+        min/max allreduce of the length, then of the padded bytes."""
+        n = len(bs)
+        lo = np.array([n], np.int32)
+        hi = np.array([n], np.int32)
+        out_lo = np.zeros(1, np.int32)
+        out_hi = np.zeros(1, np.int32)
+        self.all_reduce(Workspace(lo, out_lo, ReduceOp.MIN, f":consensus:len:min:{name}"))
+        self.all_reduce(Workspace(hi, out_hi, ReduceOp.MAX, f":consensus:len:max:{name}"))
+        if out_lo[0] != out_hi[0]:
+            return False
+        if n == 0:
+            return True
+        x = np.frombuffer(bs, np.uint8)
+        out1 = np.zeros(n, np.uint8)
+        out2 = np.zeros(n, np.uint8)
+        self.all_reduce(Workspace(x, out1, ReduceOp.MIN, f":consensus:min:{name}"))
+        self.all_reduce(Workspace(x, out2, ReduceOp.MAX, f":consensus:max:{name}"))
+        return bool(np.array_equal(out1, out2))
+
+    def gather(self, w: Workspace) -> None:
+        """Rank 0 receives everyone's send buffer into recv (rank-major);
+        parity: runGather (session.go:195-221)."""
+        root = 0
+        count = w.send.size
+        if self.rank != root:
+            self.client.send(
+                self.peers[root], w.name, w.send.tobytes(), ConnType.COLLECTIVE
+            )
+            return
+        jobs = []
+        for r, peer in enumerate(self.peers):
+            dst = w.recv[r * count:(r + 1) * count]
+            if r == self.rank:
+                np.copyto(dst, w.send)
+            else:
+                jobs.append(lambda p=peer, d=dst: self._recv_into(p, w.name, d))
+        _par(jobs, self.timeout)
+
+    def all_gather(self, w: Workspace) -> None:
+        """Gather to root then broadcast the concatenation (parity:
+        AllGatherTransform, session.cpp:201-220)."""
+        self.gather(w)
+        bw = Workspace(send=w.recv, recv=w.recv, op=w.op, name=w.name + ":bcast")
+        self.broadcast(bw)
+
+    # ------------------------------------------------------------------
+    # engine
+    # ------------------------------------------------------------------
+
+    def _recv_into(self, peer: PeerID, name: str, dst: np.ndarray) -> None:
+        msg = self.endpoint.recv(peer, name, self.timeout)
+        src = np.frombuffer(msg.data, dst.dtype)
+        np.copyto(dst, src)
+
+    def _run_strategies(self, w: Workspace, strategies: List[st.StrategyPair]) -> None:
+        total = w.recv.size * w.recv.itemsize
+        k = max(1, -(-total // CHUNK_BYTES))
+        chunks = w.split(even_partition, k) if k > 1 else [w]
+        if k == 1:
+            pair = strategies[0]
+            self._run_graphs(chunks[0], [pair.reduce_graph, pair.bcast_graph])
+            return
+        jobs = []
+        for i, chunk in enumerate(chunks):
+            pair = st.choose(strategies, i)
+            jobs.append(
+                lambda c=chunk, p=pair: self._run_graphs(
+                    c, [p.reduce_graph, p.bcast_graph]
+                )
+            )
+        _par(jobs, self.timeout)
+
+    def _run_graphs(self, w: Workspace, graphs: List[Graph]) -> None:
+        """The hot walk; parity: runGraphs (session.go:231-299)."""
+        if w.is_empty:
+            return
+        if all(g.is_isolated(self.rank) for g in graphs):
+            w.forward()
+            return
+
+        state = {"recv_count": 0}
+        lock = threading.Lock()
+
+        def effective() -> np.ndarray:
+            if state["recv_count"] > 0 or w.is_inplace:
+                return w.recv
+            return w.send
+
+        def send_to(peer: PeerID, flags: Flags = Flags.NONE) -> None:
+            self.client.send(
+                peer, w.name, effective().tobytes(), ConnType.COLLECTIVE, flags
+            )
+
+        def recv_onto(peer: PeerID) -> None:
+            msg = self.endpoint.recv(peer, w.name, self.timeout)
+            incoming = np.frombuffer(msg.data, w.send.dtype)
+            with lock:
+                if state["recv_count"] == 0 and not w.is_inplace:
+                    # first arrival: recv = send (op) incoming
+                    from kungfu_tpu.base.ops import transform2
+
+                    transform2(w.recv, w.send, incoming, w.op)
+                else:
+                    reduce_inplace(w.recv, incoming, w.op)
+                state["recv_count"] += 1
+
+        def recv_into(peer: PeerID) -> None:
+            self._recv_into(peer, w.name, w.recv)
+            with lock:
+                state["recv_count"] += 1
+
+        for g in graphs:
+            prevs = [self.peers[r] for r in g.prevs(self.rank)]
+            nexts = [self.peers[r] for r in g.nexts(self.rank)]
+            if g.is_self_loop(self.rank):
+                # accumulate: receive from all prevs (parallel), then send on
+                _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout)
+                _par([lambda p=p: send_to(p) for p in nexts], self.timeout)
+            else:
+                # pass-through node: take value from single prev (or forward
+                # own), relay to nexts
+                if not prevs and state["recv_count"] == 0:
+                    w.forward()
+                else:
+                    for p in prevs:
+                        recv_into(p)
+                _par(
+                    [lambda p=p: send_to(p, Flags.WAIT_RECV_BUF) for p in nexts],
+                    self.timeout,
+                )

@@ -1,0 +1,63 @@
+"""ElasticState: progress-based elastic training loop driver.
+
+Capability parity: srcs/python/kungfu/python/elastic_state.py:4-79 —
+  es = ElasticState(max_progress)
+  while not es.stopped():
+      with es.scope():          # begin(): sync progress after resize
+          train_one_batch()
+          es.advance(batch_size)  # end(): progress += n, maybe resize
+Stop reasons: 'finished' | 'detached' | 'reload'.
+"""
+
+from __future__ import annotations
+
+import contextlib
+from typing import Optional
+
+from kungfu_tpu import api
+
+
+class ElasticState:
+    def __init__(self, max_progress: Optional[int] = None, reload_mode: bool = False):
+        from kungfu_tpu.peer import get_default_peer
+
+        self.max_progress = max_progress
+        self.reload_mode = reload_mode
+        self._peer = get_default_peer()
+        self.progress = self._peer.config.init_progress
+        self._synced = False
+        self._stop_reason: Optional[str] = None
+
+    def begin(self) -> None:
+        if not self._synced:
+            # after a membership change, everyone adopts the max progress
+            self.progress = api.all_reduce_int_max(self.progress)
+            self._synced = True
+
+    def end(self, delta: int = 1) -> None:
+        self.progress += delta
+        if self.max_progress is not None and self.progress >= self.max_progress:
+            self._stop_reason = "finished"
+            return
+        if self.reload_mode:
+            changed, _ = api.change_cluster(self.progress)
+            if changed:
+                self._stop_reason = "reload"
+            return
+        changed, detached = api.resize()
+        if detached:
+            self._stop_reason = "detached"
+        elif changed:
+            self._synced = False
+
+    @contextlib.contextmanager
+    def scope(self):
+        self.begin()
+        yield
+
+    def stopped(self) -> bool:
+        return self._stop_reason is not None
+
+    @property
+    def stop_reason(self) -> Optional[str]:
+        return self._stop_reason

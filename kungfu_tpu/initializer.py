@@ -1,0 +1,33 @@
+"""State broadcast at (re)initialization.
+
+Capability parity: srcs/python/kungfu/tensorflow/initializer/__init__.py —
+broadcast_variables makes every worker start from rank-0's weights (also
+used after elastic resizes to bring joiners in sync).
+
+TPU-native mapping:
+- Within one mesh (single controller), replication via `jax.device_put` IS
+  the broadcast — there is exactly one logical value.
+- Across processes (multi-host pod, or workers rejoining after an elastic
+  resize), host-level values can diverge; `broadcast_variables` forces
+  process-0's values everywhere (XLA AllReduce under the hood via
+  multihost_utils), mirroring BroadcastGlobalVariablesOp.
+"""
+
+from __future__ import annotations
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+
+def broadcast_variables(tree, mesh: Mesh = None):
+    """Force every process to process-0's values, then replicate on-mesh.
+
+    Single-process: pure replication (no communication).
+    """
+    if jax.process_count() > 1:
+        from jax.experimental import multihost_utils
+
+        tree = multihost_utils.broadcast_one_to_all(tree)
+    if mesh is not None:
+        tree = jax.device_put(tree, NamedSharding(mesh, P()))
+    return tree

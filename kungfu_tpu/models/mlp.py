@@ -1,0 +1,46 @@
+"""MNIST SLP/MLP — the minimum end-to-end training slice.
+
+Parity: the reference's examples/tf2_mnist_gradient_tape.py +
+tests/python/integration/test_mnist_slp.py use a single-layer perceptron as
+the smallest real training workload; same role here.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+
+MLP_PARITY_NOTE = "examples/tf2_mnist_gradient_tape.py equivalent workload"
+
+
+def init_mlp(key, in_dim: int = 784, hidden: int = 0, out_dim: int = 10):
+    """hidden=0 gives the reference's single-layer perceptron."""
+    if hidden:
+        k1, k2 = jax.random.split(key)
+        scale1 = 1.0 / jnp.sqrt(in_dim)
+        scale2 = 1.0 / jnp.sqrt(hidden)
+        return {
+            "w1": jax.random.normal(k1, (in_dim, hidden)) * scale1,
+            "b1": jnp.zeros((hidden,)),
+            "w2": jax.random.normal(k2, (hidden, out_dim)) * scale2,
+            "b2": jnp.zeros((out_dim,)),
+        }
+    scale = 1.0 / jnp.sqrt(in_dim)
+    return {
+        "w": jax.random.normal(key, (in_dim, out_dim)) * scale,
+        "b": jnp.zeros((out_dim,)),
+    }
+
+
+def mlp_apply(params, x):
+    if "w1" in params:
+        h = jax.nn.relu(x @ params["w1"] + params["b1"])
+        return h @ params["w2"] + params["b2"]
+    return x @ params["w"] + params["b"]
+
+
+def mlp_loss(params, batch):
+    x, y = batch
+    logits = mlp_apply(params, x)
+    logp = jax.nn.log_softmax(logits)
+    return -jnp.mean(jnp.sum(jax.nn.one_hot(y, logits.shape[-1]) * logp, axis=-1))

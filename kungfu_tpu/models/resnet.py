@@ -1,0 +1,109 @@
+"""ResNet-50 (flax) — the headline throughput benchmark workload.
+
+Parity: the reference's benchmark model (README "Benchmark": ResNet-50
+S-SGD throughput vs Horovod on 16 V100; BASELINE.md north-star metric is
+ResNet-50 images/sec/chip). Standard bottleneck-v1.5 architecture.
+
+TPU notes: NHWC layout (XLA-TPU native), bfloat16 compute with f32
+batch-norm statistics and params.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax
+import jax.numpy as jnp
+
+ModuleDef = Any
+
+
+class BottleneckBlock(nn.Module):
+    filters: int
+    strides: Tuple[int, int]
+    conv: ModuleDef
+    norm: ModuleDef
+
+    @nn.compact
+    def __call__(self, x):
+        residual = x
+        y = self.conv(self.filters, (1, 1))(x)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters, (3, 3), self.strides)(y)
+        y = self.norm()(y)
+        y = nn.relu(y)
+        y = self.conv(self.filters * 4, (1, 1))(y)
+        y = self.norm(scale_init=nn.initializers.zeros)(y)
+        if residual.shape != y.shape:
+            residual = self.conv(self.filters * 4, (1, 1), self.strides, name="conv_proj")(residual)
+            residual = self.norm(name="norm_proj")(residual)
+        return nn.relu(residual + y)
+
+
+class ResNet(nn.Module):
+    stage_sizes: Sequence[int]
+    num_classes: int = 1000
+    num_filters: int = 64
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x, train: bool = True):
+        conv = partial(nn.Conv, use_bias=False, dtype=self.dtype)
+        norm = partial(
+            nn.BatchNorm,
+            use_running_average=not train,
+            momentum=0.9,
+            epsilon=1e-5,
+            dtype=self.dtype,
+        )
+        x = x.astype(self.dtype)
+        x = conv(self.num_filters, (7, 7), (2, 2), padding=[(3, 3), (3, 3)], name="conv_init")(x)
+        x = norm(name="bn_init")(x)
+        x = nn.relu(x)
+        x = nn.max_pool(x, (3, 3), strides=(2, 2), padding="SAME")
+        for i, block_count in enumerate(self.stage_sizes):
+            for j in range(block_count):
+                strides = (2, 2) if i > 0 and j == 0 else (1, 1)
+                x = BottleneckBlock(
+                    filters=self.num_filters * 2**i,
+                    strides=strides,
+                    conv=conv,
+                    norm=norm,
+                )(x)
+        x = jnp.mean(x, axis=(1, 2))
+        x = nn.Dense(self.num_classes, dtype=jnp.float32)(x)
+        return x.astype(jnp.float32)
+
+
+def resnet50(num_classes: int = 1000, dtype=jnp.bfloat16) -> ResNet:
+    return ResNet(stage_sizes=[3, 4, 6, 3], num_classes=num_classes, dtype=dtype)
+
+
+def resnet18_thin(num_classes: int = 10, dtype=jnp.bfloat16) -> ResNet:
+    """Small variant for CPU-mesh tests."""
+    return ResNet(stage_sizes=[1, 1], num_classes=num_classes, num_filters=8, dtype=dtype)
+
+
+def init_resnet(key, model: ResNet, image_size: int = 224, batch: int = 1):
+    dummy = jnp.zeros((batch, image_size, image_size, 3), jnp.float32)
+    variables = model.init({"params": key}, dummy, train=False)
+    return variables["params"], variables.get("batch_stats", {})
+
+
+def resnet_loss(model: ResNet, params, batch_stats, batch):
+    """Returns (loss, new_batch_stats)."""
+    images, labels = batch
+    logits, updates = model.apply(
+        {"params": params, "batch_stats": batch_stats},
+        images,
+        train=True,
+        mutable=["batch_stats"],
+    )
+    logp = jax.nn.log_softmax(logits)
+    loss = -jnp.mean(
+        jnp.sum(jax.nn.one_hot(labels, logits.shape[-1]) * logp, axis=-1)
+    )
+    return loss, updates["batch_stats"]

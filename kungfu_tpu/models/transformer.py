@@ -1,0 +1,162 @@
+"""Flagship decoder-only transformer LM with an explicit sharding plan.
+
+TPU-first design notes:
+- Params live in a plain pytree with a parallel tree of PartitionSpecs
+  (param_pspecs): Megatron-style tensor parallelism over the 'tp' mesh
+  axis (column-parallel QKV/FF-in, row-parallel O/FF-out), batch over
+  'dp', optional sequence sharding over 'sp' for activations. XLA's SPMD
+  partitioner inserts the AllReduce/AllGather collectives over ICI from
+  these annotations — nothing is hand-scheduled.
+- Compute in bfloat16 (MXU native), params and optimizer state in f32.
+- Static shapes everywhere; layers are stacked and scanned-friendly.
+
+The reference has no model code (KungFu is model-agnostic); this model is
+the framework's flagship workload for the BERT-config benchmark
+(BASELINE.md config 3) and the long-context/sequence-parallel path.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Any, Dict, Optional
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import PartitionSpec as P
+
+
+@dataclasses.dataclass(frozen=True)
+class TransformerConfig:
+    vocab_size: int = 32000
+    d_model: int = 512
+    n_heads: int = 8
+    n_layers: int = 4
+    d_ff: int = 2048
+    max_seq: int = 512
+    dtype: Any = jnp.bfloat16
+
+    @property
+    def head_dim(self) -> int:
+        assert self.d_model % self.n_heads == 0
+        return self.d_model // self.n_heads
+
+    @classmethod
+    def bert_base(cls) -> "TransformerConfig":
+        return cls(vocab_size=30522, d_model=768, n_heads=12, n_layers=12,
+                   d_ff=3072, max_seq=512)
+
+    @classmethod
+    def tiny(cls) -> "TransformerConfig":
+        return cls(vocab_size=256, d_model=64, n_heads=4, n_layers=2,
+                   d_ff=128, max_seq=64)
+
+
+def init_transformer(key, cfg: TransformerConfig) -> Dict:
+    """Params in f32; cast to cfg.dtype at apply time."""
+    keys = jax.random.split(key, 2 + cfg.n_layers)
+    scale = 0.02
+
+    def dense(k, shape):
+        return jax.random.normal(k, shape, jnp.float32) * scale
+
+    layers = []
+    for i in range(cfg.n_layers):
+        lk = jax.random.split(keys[2 + i], 4)
+        layers.append({
+            "ln1_scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "ln2_scale": jnp.ones((cfg.d_model,), jnp.float32),
+            "wqkv": dense(lk[0], (cfg.d_model, 3 * cfg.d_model)),
+            "wo": dense(lk[1], (cfg.d_model, cfg.d_model)),
+            "w_in": dense(lk[2], (cfg.d_model, cfg.d_ff)),
+            "w_out": dense(lk[3], (cfg.d_ff, cfg.d_model)),
+        })
+    # stack layers: leading axis = layer, enables lax.scan over layers
+    stacked = jax.tree.map(lambda *xs: jnp.stack(xs), *layers)
+    return {
+        "embed": dense(keys[0], (cfg.vocab_size, cfg.d_model)),
+        "pos_embed": dense(keys[1], (cfg.max_seq, cfg.d_model)),
+        "ln_f_scale": jnp.ones((cfg.d_model,), jnp.float32),
+        "layers": stacked,
+    }
+
+
+def param_pspecs(cfg: TransformerConfig, tp_axis: str = "tp") -> Dict:
+    """PartitionSpec tree matching init_transformer's param tree.
+
+    Column-parallel wqkv/w_in (shard output features over tp), row-parallel
+    wo/w_out (shard input features over tp); embedding sharded over vocab.
+    Layer-stacked leaves have a leading layer axis (unsharded).
+    """
+    t = tp_axis
+    return {
+        "embed": P(t, None),
+        "pos_embed": P(),
+        "ln_f_scale": P(),
+        "layers": {
+            "ln1_scale": P(None),
+            "ln2_scale": P(None),
+            "wqkv": P(None, None, t),
+            "wo": P(None, t, None),
+            "w_in": P(None, None, t),
+            "w_out": P(None, t, None),
+        },
+    }
+
+
+def _rmsnorm(x, scale, eps=1e-6):
+    var = jnp.mean(jnp.square(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
+
+
+def _attention(x, wqkv, wo, cfg: TransformerConfig):
+    B, S, D = x.shape
+    H, hd = cfg.n_heads, cfg.head_dim
+    qkv = x @ wqkv  # (B, S, 3D)
+    q, k, v = jnp.split(qkv, 3, axis=-1)
+    q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd).astype(x.dtype)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
+    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
+    return ctx @ wo
+
+
+def _block(x, layer, cfg: TransformerConfig):
+    dt = cfg.dtype
+    x = x + _attention(_rmsnorm(x, layer["ln1_scale"]),
+                       layer["wqkv"].astype(dt), layer["wo"].astype(dt), cfg)
+    h = _rmsnorm(x, layer["ln2_scale"])
+    h = jax.nn.gelu(h @ layer["w_in"].astype(dt))
+    return x + h @ layer["w_out"].astype(dt)
+
+
+def transformer_apply(params, tokens, cfg: TransformerConfig):
+    """tokens (B, S) int32 -> logits (B, S, V) in f32."""
+    B, S = tokens.shape
+    dt = cfg.dtype
+    x = params["embed"].astype(dt)[tokens] + params["pos_embed"].astype(dt)[:S]
+
+    def body(x, layer):
+        return _block(x, layer, cfg), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f_scale"])
+    logits = x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+    return logits
+
+
+def transformer_loss(params, batch, cfg: TransformerConfig):
+    """Next-token cross-entropy. batch = tokens (B, S+1) or (tokens, targets)."""
+    if isinstance(batch, (tuple, list)):
+        tokens, targets = batch
+    else:
+        tokens, targets = batch[:, :-1], batch[:, 1:]
+    logits = transformer_apply(params, tokens, cfg)
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)

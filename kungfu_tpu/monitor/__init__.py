@@ -1,0 +1,8 @@
+from kungfu_tpu.monitor.noise_scale import (
+    GNSState,
+    gns_init,
+    gns_update,
+    monitor_gradient_noise_scale,
+)
+
+__all__ = ["GNSState", "gns_init", "gns_update", "monitor_gradient_noise_scale"]

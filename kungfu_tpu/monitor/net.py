@@ -1,0 +1,138 @@
+"""Network monitor: per-peer egress/ingress byte counters + rate windows.
+
+Capability parity: srcs/go/monitor/{monitor,counters,server}.go — totals
+and windowed rates per peer, Prometheus-style text endpoint, enabled by
+KF_CONFIG_ENABLE_MONITORING; surfaced to training as egress_rates()
+(parity: ops/cpu/monitoring.cpp:5-22 + session monitoring).
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import time
+from collections import defaultdict, deque
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, List, Optional, Tuple
+
+from kungfu_tpu.plan.peer import PeerID
+
+DEFAULT_WINDOW = 1.0  # seconds
+
+
+def enabled() -> bool:
+    return os.environ.get("KF_CONFIG_ENABLE_MONITORING", "") in ("1", "true")
+
+
+class RateCounter:
+    """Monotonic byte counter with a sliding-window rate estimate."""
+
+    def __init__(self, window: float = DEFAULT_WINDOW):
+        self._lock = threading.Lock()
+        self._total = 0
+        self._window = window
+        self._samples: deque = deque()  # (t, total)
+
+    def add(self, n: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            self._total += n
+            self._samples.append((now, self._total))
+            cutoff = now - self._window
+            while len(self._samples) > 1 and self._samples[0][0] < cutoff:
+                self._samples.popleft()
+
+    @property
+    def total(self) -> int:
+        with self._lock:
+            return self._total
+
+    def rate(self) -> float:
+        """Bytes/sec over the window."""
+        with self._lock:
+            if len(self._samples) < 2:
+                return 0.0
+            (t0, b0), (t1, b1) = self._samples[0], self._samples[-1]
+            if t1 <= t0:
+                return 0.0
+            return (b1 - b0) / (t1 - t0)
+
+
+class NetMonitor:
+    def __init__(self):
+        self._egress: Dict[PeerID, RateCounter] = defaultdict(RateCounter)
+        self._ingress: Dict[PeerID, RateCounter] = defaultdict(RateCounter)
+
+    def sent(self, peer: PeerID, n: int) -> None:
+        self._egress[peer].add(n)
+
+    def received(self, peer: PeerID, n: int) -> None:
+        self._ingress[peer].add(n)
+
+    def egress_totals(self) -> Dict[PeerID, int]:
+        return {p: c.total for p, c in self._egress.items()}
+
+    def egress_rates(self, peers: List[PeerID]) -> List[float]:
+        """Rates aligned to a rank order (parity: GetEgressRates)."""
+        return [self._egress[p].rate() if p in self._egress else 0.0 for p in peers]
+
+    def ingress_rates(self, peers: List[PeerID]) -> List[float]:
+        return [self._ingress[p].rate() if p in self._ingress else 0.0 for p in peers]
+
+    def render_metrics(self) -> str:
+        """Prometheus-style exposition (parity: monitor/server.go)."""
+        lines = []
+        for name, table in (("egress", self._egress), ("ingress", self._ingress)):
+            lines.append(f"# TYPE kungfu_{name}_bytes counter")
+            for p, c in sorted(table.items(), key=lambda kv: str(kv[0])):
+                lines.append(
+                    f'kungfu_{name}_bytes{{peer="{p}"}} {c.total}'
+                )
+            lines.append(f"# TYPE kungfu_{name}_rate gauge")
+            for p, c in sorted(table.items(), key=lambda kv: str(kv[0])):
+                lines.append(
+                    f'kungfu_{name}_rate{{peer="{p}"}} {c.rate():.1f}'
+                )
+        return "\n".join(lines) + "\n"
+
+
+_global_monitor: Optional[NetMonitor] = None
+_monitor_lock = threading.Lock()
+
+
+def get_monitor() -> NetMonitor:
+    global _global_monitor
+    with _monitor_lock:
+        if _global_monitor is None:
+            _global_monitor = NetMonitor()
+        return _global_monitor
+
+
+class MetricsServer:
+    """/metrics HTTP endpoint (parity: peer's port+10000 server)."""
+
+    def __init__(self, monitor: NetMonitor, port: int):
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(inner):
+                if inner.path.rstrip("/") != "/metrics":
+                    inner.send_response(404)
+                    inner.end_headers()
+                    return
+                body = monitor.render_metrics().encode()
+                inner.send_response(200)
+                inner.send_header("Content-Type", "text/plain")
+                inner.send_header("Content-Length", str(len(body)))
+                inner.end_headers()
+                inner.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    def start(self):
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()

@@ -1,0 +1,19 @@
+from kungfu_tpu.ops.collective import (
+    all_gather,
+    all_reduce,
+    broadcast,
+    defuse,
+    fuse,
+    group_all_reduce,
+    subset_all_reduce,
+)
+
+__all__ = [
+    "all_gather",
+    "all_reduce",
+    "broadcast",
+    "defuse",
+    "fuse",
+    "group_all_reduce",
+    "subset_all_reduce",
+]

@@ -1,0 +1,11 @@
+from kungfu_tpu.optimizers.core import (
+    adaptive_sgd,
+    synchronous_averaging,
+    synchronous_sgd,
+)
+
+__all__ = [
+    "adaptive_sgd",
+    "synchronous_averaging",
+    "synchronous_sgd",
+]

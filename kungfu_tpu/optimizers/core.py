@@ -1,0 +1,121 @@
+"""Distributed optimizer wrappers as optax gradient transformations.
+
+Capability parity with the reference optimizer framework
+(srcs/python/kungfu/tensorflow/optimizers/core.py + sync_sgd.py, sma_sgd.py,
+ada_sgd.py): each wrapper takes a base optax optimizer and injects
+cross-replica communication into the update. TPU-first: the communication
+is `lax.pmean`/`psum` traced into the SAME compiled program as the model
+step, so grad-allreduce overlaps backprop under XLA's scheduler — there is
+no op-ordering problem (the NCCL scheduler's job, scheduler.cpp:37-129, is
+subsumed by XLA's static schedule).
+
+All wrappers must run inside a `shard_map` over the mesh axis they reduce
+on (see kungfu_tpu.parallel.make_train_step).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+import optax
+from jax import lax
+
+
+def synchronous_sgd(base: optax.GradientTransformation, axis_name: str = "dp") -> optax.GradientTransformation:
+    """S-SGD (parity: SynchronousSGDOptimizer, sync_sgd.py:15-109): average
+    gradients over the axis before the base update. One fused XLA AllReduce
+    per step (XLA combines the per-leaf psums)."""
+
+    def init(params):
+        return base.init(params)
+
+    def update(grads, state, params=None, **extra):
+        grads = jax.tree.map(lambda g: lax.pmean(g, axis_name), grads)
+        return base.update(grads, state, params, **extra)
+
+    return optax.GradientTransformation(init, update)
+
+
+class _SMAState(NamedTuple):
+    base: optax.OptState
+
+
+def synchronous_averaging(
+    base: optax.GradientTransformation,
+    axis_name: str = "dp",
+    alpha: float = 0.1,
+) -> optax.GradientTransformation:
+    """SMA / EA-SGD (parity: SynchronousAveragingOptimizer, sma_sgd.py:9-75):
+    each step blends params toward the cluster average with weight ``alpha``,
+    then applies the LOCAL gradients. Converges better than S-SGD at large
+    cluster sizes (reference README: 75% vs 59% top-1 at 16 workers)."""
+
+    def init(params):
+        return _SMAState(base=base.init(params))
+
+    def update(grads, state, params, **extra):
+        if params is None:
+            raise ValueError("synchronous_averaging requires params")
+        avg = jax.tree.map(lambda p: lax.pmean(p, axis_name), params)
+        base_updates, base_state = base.update(grads, state.base, params, **extra)
+        # total update = alpha * (avg - p) + base_update(local grads)
+        updates = jax.tree.map(
+            lambda a, p, u: alpha * (a - p) + u, avg, params, base_updates
+        )
+        return updates, _SMAState(base=base_state)
+
+    return optax.GradientTransformation(init, update)
+
+
+class _AdaSGDState(NamedTuple):
+    step: jnp.ndarray
+    sma: optax.OptState
+    ssgd: optax.OptState
+
+
+def adaptive_sgd(
+    base: optax.GradientTransformation,
+    change_step: int,
+    axis_name: str = "dp",
+    alpha: float = 0.1,
+) -> optax.GradientTransformation:
+    """AdaptiveSGD (parity: AdaSGDOptimizer, ada_sgd.py:12-84): SMA before
+    ``change_step``, S-SGD after. The switch is a `lax.cond` so one compiled
+    program covers both phases (no recompilation at the switch). At the
+    switch step the update folds in a rank-0 re-broadcast of the params
+    (parity: AdaSGDHook re-broadcast) — SMA's local-gradient steps let
+    replicas diverge, and S-SGD alone would freeze that divergence in."""
+    sma = synchronous_averaging(base, axis_name, alpha)
+    ssgd = synchronous_sgd(base, axis_name)
+
+    def init(params):
+        return _AdaSGDState(
+            step=jnp.zeros((), jnp.int32),
+            sma=sma.init(params),
+            ssgd=ssgd.init(params),
+        )
+
+    def update(grads, state, params, **extra):
+        def run_sma(_):
+            u, s = sma.update(grads, state.sma, params, **extra)
+            return u, _AdaSGDState(state.step + 1, s, state.ssgd)
+
+        def run_ssgd(_):
+            u, s = ssgd.update(grads, state.ssgd, params, **extra)
+            if params is not None:
+                # switch step: fold in the rank-0 re-sync broadcast
+                from kungfu_tpu.ops.collective import broadcast
+
+                at_switch = (state.step == change_step).astype(jnp.float32)
+                u = jax.tree.map(
+                    lambda ui, p: ui + at_switch * (broadcast(p, axis_name) - p),
+                    u,
+                    params,
+                )
+            return u, _AdaSGDState(state.step + 1, state.sma, s)
+
+        return lax.cond(state.step < change_step, run_sma, run_ssgd, None)
+
+    return optax.GradientTransformation(init, update)

@@ -1,0 +1,149 @@
+"""PairAveraging (AD-PSGD): asynchronous decentralized data parallelism.
+
+Capability parity: srcs/python/kungfu/tensorflow/optimizers/async_sgd.py
+(_PairAveraging) + the p2p versioned store (srcs/go/store, handler/p2p.go)
++ the AsyncRequestModel prefetch pattern (ops/cpu/peer_to_peer.cpp:166-258).
+
+Per step: pick a random peer, fetch its (fused) model from its host-side
+store, average 0.5/0.5 with our params, apply local gradients, publish our
+new model. No global barrier — workers proceed at their own pace; stale
+peers are tolerated (that is the algorithm's point).
+
+TPU mapping (SURVEY §7 hard-parts): a device pull mid-step is not
+XLA-friendly, so the exchange is host-side and OVERLAPPED: a background
+thread prefetches the next peer's model while the device runs the current
+step; the averaging+apply is one compiled program taking the fetched fused
+vector as a plain input.
+"""
+
+from __future__ import annotations
+
+import random
+import threading
+from typing import Callable, List, Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+
+def _fuse_host(tree) -> np.ndarray:
+    leaves = jax.tree.leaves(jax.device_get(tree))
+    return np.concatenate([np.ravel(np.asarray(l, np.float32)) for l in leaves])
+
+
+class PairAveraging:
+    """Trainer-side driver owning the p2p exchange.
+
+    peer: kungfu_tpu.peer.Peer (host runtime); base: optax transformation.
+    """
+
+    BLOB = "pair-avg-model"
+
+    def __init__(
+        self,
+        base: optax.GradientTransformation,
+        peer=None,
+        name: str = "model",
+        rng: Optional[random.Random] = None,
+    ):
+        if peer is None:
+            from kungfu_tpu.peer import get_default_peer
+
+            peer = get_default_peer()
+        self.peer = peer
+        self.base = base
+        self.blob = f"{self.BLOB}:{name}"
+        self.rng = rng or random.Random(peer.rank * 7919 + 17)
+        self._prefetch: Optional[threading.Thread] = None
+        self._fetched: List[Optional[np.ndarray]] = [None]  # per-thread slot
+        self._shapes = None
+        self._step_fns = {}
+
+    # -- jitted compute ------------------------------------------------
+    def _build(self, params):
+        leaves, treedef = jax.tree.flatten(params)
+        shapes = [l.shape for l in leaves]
+        dtypes = [l.dtype for l in leaves]
+        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
+        self._shapes = (treedef, shapes, dtypes, sizes)
+
+        def unflatten(vec):
+            out, off = [], 0
+            for shape, dt, size in zip(shapes, dtypes, sizes):
+                out.append(jnp.reshape(vec[off:off + size], shape).astype(dt))
+                off += size
+            return jax.tree.unflatten(treedef, out)
+
+        @jax.jit
+        def avg_apply(params, other_vec, grads, opt_state):
+            other = unflatten(other_vec)
+            params = jax.tree.map(lambda p, o: 0.5 * (p + o), params, other)
+            updates, opt_state = self.base.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        @jax.jit
+        def apply_only(params, grads, opt_state):
+            updates, opt_state = self.base.update(grads, opt_state, params)
+            return optax.apply_updates(params, updates), opt_state
+
+        self._step_fns = {"avg": avg_apply, "plain": apply_only}
+
+    # -- host-side exchange --------------------------------------------
+    def _random_peer_rank(self) -> Optional[int]:
+        size = self.peer.size
+        if size <= 1:
+            return None
+        r = self.rng.randrange(size - 1)
+        return r + 1 if r >= self.peer.rank else r
+
+    def _start_prefetch(self) -> None:
+        target = self._random_peer_rank()
+        if target is None:
+            return
+
+        slot: List[Optional[np.ndarray]] = [None]
+
+        def fetch():
+            sess = self.peer.current_session()
+            try:
+                data = self.peer.p2p.request(sess.peers[target], self.blob, timeout=30)
+            except (ConnectionError, TimeoutError, OSError):
+                data = None
+            slot[0] = np.frombuffer(data, np.float32) if data is not None else None
+
+        self._fetched = slot
+        self._prefetch = threading.Thread(target=fetch, daemon=True)
+        self._prefetch.start()
+
+    def init(self, params) -> optax.OptState:
+        """Publish the initial model, fence, start the first prefetch
+        (parity: async_sgd.py:106-108 init-store + barrier)."""
+        self._build(params)
+        self.peer.p2p.save(self.blob, _fuse_host(params).tobytes())
+        if not self.peer.config.single_process:
+            self.peer.current_session().barrier(tag=":pair-avg-init")
+        self._start_prefetch()
+        return self.base.init(params)
+
+    def step(self, params, opt_state, grads):
+        """One training step; call with the already-computed LOCAL grads."""
+        other: Optional[np.ndarray] = None
+        if self._prefetch is not None:
+            self._prefetch.join(timeout=30)
+            if not self._prefetch.is_alive():
+                # orphaned fetches keep writing only their own slot, so a
+                # timed-out thread can never clobber a later prefetch
+                other = self._fetched[0]
+            self._prefetch = None
+        if other is not None and other.size:
+            params, opt_state = self._step_fns["avg"](
+                params, jnp.asarray(other), grads, opt_state
+            )
+        else:
+            params, opt_state = self._step_fns["plain"](params, grads, opt_state)
+        # publish new model, then overlap the next fetch with caller compute
+        self.peer.p2p.save(self.blob, _fuse_host(params).tobytes())
+        self._start_prefetch()
+        return params, opt_state

@@ -1,0 +1,4 @@
+from kungfu_tpu.parallel.mesh import DeviceSession, make_mesh
+from kungfu_tpu.parallel.dp import make_train_step
+
+__all__ = ["DeviceSession", "make_mesh", "make_train_step"]

@@ -1,0 +1,243 @@
+"""Peer: the worker-side runtime root.
+
+Capability parity: srcs/go/kungfu/peer/peer.go:27-308 — every worker embeds
+the whole host-side communication runtime: a transport server+client, the
+current cluster (version'd), a HostSession cache, and the elastic-resize
+protocol (consensus on a proposed cluster, notify runners, bump version,
+rebuild session, barrier).
+
+TPU mapping: the Peer manages the HOST plane only. Device work happens in
+DeviceSession (kungfu_tpu.parallel.mesh); on a resize the worker process is
+expected to rebuild its DeviceSession/mesh (reload-style), which is the
+TPU-native elastic mode (ICI mesh shape is fixed per slice — SURVEY §7).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+import urllib.request
+from typing import Optional, Tuple
+
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.collective.host_session import HostSession
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.peer import PeerID, PeerList
+from kungfu_tpu.runner import env as kfenv
+from kungfu_tpu.store.versioned import BlobStore
+from kungfu_tpu.transport.client import Client
+from kungfu_tpu.transport.handlers import (
+    CollectiveEndpoint,
+    ControlEndpoint,
+    P2PEndpoint,
+    QueueEndpoint,
+)
+from kungfu_tpu.transport.message import ConnType, Flags, Message
+from kungfu_tpu.transport.server import Server
+
+_default_peer: Optional["Peer"] = None
+_default_lock = threading.Lock()
+
+
+def get_default_peer() -> "Peer":
+    """Process-wide singleton (parity: Peer::GetDefault, peer.hpp)."""
+    global _default_peer
+    with _default_lock:
+        if _default_peer is None:
+            _default_peer = Peer(kfenv.parse_config_from_env())
+            _default_peer.start()
+        return _default_peer
+
+
+def finalize_default_peer() -> None:
+    global _default_peer
+    with _default_lock:
+        if _default_peer is not None:
+            _default_peer.stop()
+            _default_peer = None
+
+
+class Peer:
+    def __init__(self, config: kfenv.WorkerConfig):
+        self.config = config
+        self.self_id = config.self_id
+        self.cluster_version = config.cluster_version
+        self.detached = False
+        self._peers = config.peers
+        self._session: Optional[HostSession] = None
+        self._session_lock = threading.RLock()
+        self._updated = True
+
+        self.store = BlobStore()
+        self.client = Client(self.self_id, use_unix=not config.single_process)
+        self.server = Server(self.self_id, use_unix=not config.single_process)
+        self.collective = CollectiveEndpoint()
+        self.queue = QueueEndpoint()
+        self.p2p = P2PEndpoint(self.store, self.client, self.self_id)
+        self.server.register(ConnType.COLLECTIVE, self.collective.handle)
+        self.server.register(ConnType.QUEUE, self.queue.handle)
+        self.server.register(ConnType.PEER_TO_PEER, self.p2p.handle)
+
+    # ------------------------------------------------------------------
+    def start(self) -> None:
+        if not self.config.single_process:
+            self.server.start()
+        self._update_to(self._peers)
+
+    def stop(self) -> None:
+        self.server.stop()
+        self.client.close()
+
+    # ------------------------------------------------------------------
+    @property
+    def rank(self) -> int:
+        return self.current_session().rank
+
+    @property
+    def size(self) -> int:
+        return self.current_session().size
+
+    def current_session(self) -> HostSession:
+        with self._session_lock:
+            if self._session is None:
+                raise RuntimeError("peer not started")
+            return self._session
+
+    def _update_to(self, peers: PeerList) -> bool:
+        """Rebuild the session for a new peer list; returns False if self is
+        not a member (detached). Parity: peer.updateTo (peer.go:148-170)."""
+        with self._session_lock:
+            if peers.rank(self.self_id) is None:
+                self.detached = True
+                return False
+            self.server.set_token(self.cluster_version)
+            self.client.set_token(self.cluster_version)
+            self.client.reset_connections()
+            self._session = HostSession(
+                self.config.strategy,
+                self.self_id,
+                peers,
+                self.client,
+                self.collective,
+            )
+            self._peers = peers
+        if not self.config.single_process:
+            self._session.barrier(tag=f":v{self.cluster_version}")
+        self._updated = True
+        return True
+
+    # ------------------------------------------------------------------
+    # elastic resize protocol (parity: peer.go propose/ResizeCluster*)
+    # ------------------------------------------------------------------
+
+    def _notify_runners(self, stage: dict) -> None:
+        """Send the new Stage to every runner (parity: peer.go:200-214)."""
+        payload = json.dumps(stage).encode()
+        for runner in self.config.runners:
+            if not self.client.wait_peer(runner, timeout=30):
+                raise ConnectionError(f"runner {runner} unreachable")
+            self.client.send(runner, "update", payload, ConnType.CONTROL)
+
+    def _propose(self, cluster: Cluster, progress: int = 0) -> Tuple[bool, bool]:
+        """Consensus-check and adopt a new cluster.
+
+        Returns (accepted, keep): keep=False means self is detached.
+        Parity: peer.propose (peer.go:181-233) including the safety check —
+        peers must agree on the proposed bytes or the resize is rejected.
+        """
+        sess = self.current_session()
+        if not sess.bytes_consensus(cluster.to_bytes(), f":propose:v{self.cluster_version}"):
+            return False, True
+        if self._peers == cluster.workers:
+            return True, True  # no change
+        stage = {
+            "Version": self.cluster_version + 1,
+            "Progress": progress,
+            "Cluster": cluster.to_json(),
+        }
+        if sess.rank == 0 and self.config.runners:
+            self._notify_runners(stage)
+        # all peers advance the version together (they all ran the consensus)
+        self.cluster_version += 1
+        keep = self._update_to(cluster.workers)
+        return True, keep
+
+    def _get_config(self, url: str) -> Optional[Cluster]:
+        try:
+            with urllib.request.urlopen(url, timeout=5) as resp:
+                return Cluster.loads(resp.read().decode())
+        except Exception:
+            return None
+
+    def _wait_new_config(self, url: str) -> Cluster:
+        """Poll the config server until all current peers see the same
+        cluster (parity: waitNewConfig, peer.go:242-263)."""
+        sess = self.current_session()
+        while True:
+            cluster = self._get_config(url)
+            if cluster is not None:
+                if sess.bytes_consensus(cluster.to_bytes(), ":cfg"):
+                    return cluster
+            else:
+                # still consense on "no config" so peers stay in lockstep
+                sess.bytes_consensus(b"", ":cfg")
+            time.sleep(0.2)
+
+    def resize_cluster_from_url(self) -> Tuple[bool, bool]:
+        """(changed, detached). Parity: ResizeClusterFromURL (peer.go:265)."""
+        url = self.config.config_server
+        if not url:
+            return False, False
+        cluster = self._wait_new_config(url)
+        if cluster.workers == self._peers:
+            return False, False
+        accepted, keep = self._propose(cluster)
+        return accepted, not keep
+
+    def resize_cluster(self, new_size: int) -> Tuple[bool, bool]:
+        """Explicit resize to new_size workers (parity: ResizeCluster)."""
+        current = Cluster(runners=self.config.runners, workers=self._peers)
+        cluster = current.resize(new_size)
+        if cluster.workers == self._peers:
+            return False, False
+        accepted, keep = self._propose(cluster)
+        return accepted, not keep
+
+    def propose_new_size(self, new_size: int) -> None:
+        """Publish a desired size to the config server (rank-agnostic;
+        parity: ProposeNewSize -> config-server PUT)."""
+        url = self.config.config_server
+        if not url:
+            raise RuntimeError("no config server configured")
+        current = Cluster(runners=self.config.runners, workers=self._peers)
+        cluster = current.resize(new_size)
+        data = cluster.dumps().encode()
+        req = urllib.request.Request(url, data=data, method="PUT")
+        with urllib.request.urlopen(req, timeout=5) as resp:
+            resp.read()
+
+    def change_cluster(self, progress: int) -> Tuple[bool, bool]:
+        """Reload-mode resize: every worker exits and the runners relaunch
+        from `progress` (parity: ChangeCluster, peer.go:279-291 +
+        ElasticModeReload). Returns (changed, detached_all)."""
+        url = self.config.config_server
+        if not url:
+            return False, False
+        cluster = self._wait_new_config(url)
+        if cluster.workers == self._peers:
+            return False, False
+        sess = self.current_session()
+        if not sess.bytes_consensus(cluster.to_bytes(), ":reload"):
+            return False, False
+        stage = {
+            "Version": self.cluster_version + 1,
+            "Progress": progress,
+            "Cluster": cluster.to_json(),
+            "Reload": True,
+        }
+        if sess.rank == 0 and self.config.runners:
+            self._notify_runners(stage)
+        # in reload mode every worker detaches; runners restart the world
+        self.detached = True
+        return True, True

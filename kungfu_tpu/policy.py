@@ -1,0 +1,117 @@
+"""Policy engine: lifecycle hooks driving adaptation decisions.
+
+Capability parity: srcs/python/kungfu/tensorflow/policy/{base_policy,
+policy_hook}.py — a BasePolicy gets before/after train/epoch/step
+callbacks; the runner tracks trained samples and a mutable batch size and
+stops when this worker is detached (policy_hook.py:8-77). Framework-
+agnostic here: drive it from any JAX training loop.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional
+
+
+class BasePolicy:
+    def before_train(self, ctx: "PolicyContext") -> None: ...
+
+    def after_train(self, ctx: "PolicyContext") -> None: ...
+
+    def before_epoch(self, ctx: "PolicyContext") -> None: ...
+
+    def after_epoch(self, ctx: "PolicyContext") -> None: ...
+
+    def before_step(self, ctx: "PolicyContext") -> None: ...
+
+    def after_step(self, ctx: "PolicyContext") -> None: ...
+
+
+class PolicyContext:
+    """Mutable training-run state shared with policies."""
+
+    def __init__(self, batch_size: int, total_samples: Optional[int] = None):
+        self.batch_size = batch_size
+        self.total_samples = total_samples
+        self.trained_samples = 0
+        self.epoch = 0
+        self.step = 0
+        self.metrics: dict = {}
+        self.stopped = False
+
+    def request_stop(self) -> None:
+        self.stopped = True
+
+
+class PolicyRunner:
+    """Drives policies through a training loop.
+
+    with PolicyRunner([p1, p2], batch_size=64) as runner:
+        for epoch in ...:
+            with runner.epoch():
+                for batch in ...:
+                    with runner.step():
+                        train(batch)
+                    if runner.ctx.stopped: ...
+    """
+
+    def __init__(self, policies: List[BasePolicy], batch_size: int,
+                 total_samples: Optional[int] = None):
+        self.policies = policies
+        self.ctx = PolicyContext(batch_size, total_samples)
+
+    def __enter__(self):
+        for p in self.policies:
+            p.before_train(self.ctx)
+        return self
+
+    def __exit__(self, *exc):
+        for p in self.policies:
+            p.after_train(self.ctx)
+        return False
+
+    def epoch(self):
+        return _Scope(
+            enter=lambda: [p.before_epoch(self.ctx) for p in self.policies],
+            exit=lambda: (
+                [p.after_epoch(self.ctx) for p in self.policies],
+                setattr(self.ctx, "epoch", self.ctx.epoch + 1),
+            ),
+        )
+
+    def step(self):
+        def after():
+            self.ctx.trained_samples += self.ctx.batch_size
+            self.ctx.step += 1
+            for p in self.policies:
+                p.after_step(self.ctx)
+            try:
+                from kungfu_tpu import api
+
+                if api.detached():
+                    self.ctx.request_stop()
+            except Exception:
+                pass
+            if (
+                self.ctx.total_samples is not None
+                and self.ctx.trained_samples >= self.ctx.total_samples
+            ):
+                self.ctx.request_stop()
+
+        return _Scope(
+            enter=lambda: [p.before_step(self.ctx) for p in self.policies],
+            exit=after,
+        )
+
+
+class _Scope:
+    def __init__(self, enter, exit):
+        self._enter = enter
+        self._exit = exit
+
+    def __enter__(self):
+        self._enter()
+        return self
+
+    def __exit__(self, *exc):
+        self._exit()
+        return False

@@ -1,0 +1,194 @@
+"""kfrun — the per-host launcher CLI.
+
+Capability parity: srcs/go/cmd/kungfu-run (app/kungfu-run.go:18-117) +
+runner/flags.go:30-145:
+  kfrun -np 4 python3 train.py              # simple run, localhost
+  kfrun -np 4 -H h1:2,h2:2 ...              # multi-host plan (this host's
+                                            # workers only; start kfrun per host)
+  kfrun -w -config-server URL ...           # elastic watch mode
+  kfrun -np 4 -auto-recover 10s ...         # failure auto-recovery
+  kfrun -builtin-config-port 9100 ...       # embedded config server
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import signal
+import socket
+import sys
+import time
+from typing import List, Optional
+
+from kungfu_tpu.base.strategy import DEFAULT_STRATEGY, Strategy
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.hostspec import HostList, parse_hostfile
+from kungfu_tpu.plan.peer import PeerID, PeerList
+from kungfu_tpu.runner import env as kfenv
+from kungfu_tpu.runner.proc import WorkerProc, run_all
+
+DEFAULT_RUNNER_PORT = 38080
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        "kfrun", description="TPU-native KungFu launcher", allow_abbrev=False
+    )
+    p.add_argument("-np", type=int, default=1, help="number of workers")
+    p.add_argument("-H", dest="hosts", default="", help="host list ip:slots[:pub],...")
+    p.add_argument("-hostfile", default="", help="hostfile path")
+    p.add_argument("-self", dest="self_host", default="", help="this host's address")
+    p.add_argument("-strategy", default="AUTO", help=f"one of {[s.name for s in Strategy]}")
+    p.add_argument("-port-range", default="38000-38999")
+    p.add_argument("-runner-port", type=int, default=DEFAULT_RUNNER_PORT)
+    p.add_argument("-w", "--watch", action="store_true", help="elastic watch mode")
+    p.add_argument("-config-server", default="", help="config server URL")
+    p.add_argument("-builtin-config-port", type=int, default=-1,
+                   help="embed a config server on this port (0 = ephemeral)")
+    p.add_argument("-elastic-mode", default="", choices=["", "reload"])
+    p.add_argument("-auto-recover", default="", help="e.g. 10s: heartbeat auto-recovery")
+    p.add_argument("-logdir", default="")
+    p.add_argument("-q", "--quiet", action="store_true")
+    p.add_argument("-delay", type=float, default=0.0)
+    p.add_argument("-timeout", type=float, default=0.0, help="kill workers after this many seconds")
+    p.add_argument("cmd", nargs=argparse.REMAINDER, help="worker command")
+    return p
+
+
+def infer_self_host(hosts: HostList) -> str:
+    """Pick this host's address from the host list (parity:
+    runner.InferSelfIPv4; hostname/IP matching instead of NIC scanning)."""
+    candidates = {h.host for h in hosts}
+    if "127.0.0.1" in candidates or "localhost" in candidates:
+        return "127.0.0.1" if "127.0.0.1" in candidates else "localhost"
+    names = {socket.gethostname(), socket.getfqdn()}
+    try:
+        names.add(socket.gethostbyname(socket.gethostname()))
+    except OSError:
+        pass
+    for h in hosts:
+        if h.host in names:
+            return h.host
+    raise SystemExit(f"cannot find self among hosts {sorted(candidates)}; use -self")
+
+
+def parse_port_range(s: str):
+    a, _, b = s.partition("-")
+    return (int(a), int(b or a))
+
+
+def main(argv: Optional[List[str]] = None) -> int:
+    args = build_parser().parse_args(argv)
+    cmd = args.cmd
+    if cmd and cmd[0] == "--":
+        cmd = cmd[1:]
+    if not cmd:
+        print("kfrun: no worker command given", file=sys.stderr)
+        return 2
+
+    try:
+        if args.hostfile:
+            with open(args.hostfile) as f:
+                hosts = parse_hostfile(f.read())
+        elif args.hosts:
+            hosts = HostList.parse(args.hosts)
+        else:
+            hosts = HostList.parse(f"127.0.0.1:{args.np}")
+
+        port_range = parse_port_range(args.port_range)
+        workers = hosts.gen_peer_list(args.np, port_range)
+        runners = hosts.gen_runner_list(args.runner_port)
+        cluster = Cluster(runners=runners, workers=workers)
+        cluster.validate()
+        self_host = args.self_host or infer_self_host(hosts)
+        strategy = Strategy.parse(args.strategy)
+    except (ValueError, OSError) as e:
+        print(f"kfrun: {e}", file=sys.stderr)
+        return 2
+
+    config_server_url = args.config_server
+    builtin_server = None
+    if args.builtin_config_port >= 0:
+        from kungfu_tpu.elastic.configserver import ConfigServer
+
+        builtin_server = ConfigServer(args.builtin_config_port, cluster)
+        builtin_server.start()
+        config_server_url = f"http://{self_host}:{builtin_server.port}/config"
+
+    if args.delay:
+        time.sleep(args.delay)
+
+    try:
+        if args.auto_recover:
+            from kungfu_tpu.runner.monitored import monitored_run
+
+            return monitored_run(args, cmd, cluster, self_host, strategy)
+        if args.watch:
+            from kungfu_tpu.runner.watch import watch_run
+
+            return watch_run(args, cmd, cluster, self_host, strategy, config_server_url)
+        return simple_run(args, cmd, cluster, self_host, strategy, config_server_url)
+    finally:
+        if builtin_server:
+            builtin_server.stop()
+
+
+def make_one_worker_proc(
+    args, cmd, cluster: Cluster, worker: PeerID, self_host: str,
+    strategy: Strategy, config_server_url: str = "", version: int = 0,
+    progress: int = 0,
+) -> WorkerProc:
+    rank = cluster.workers.rank(worker)
+    env = kfenv.worker_env(
+        self_id=worker,
+        peers=cluster.workers,
+        runners=cluster.runners,
+        parent=PeerID(self_host, args.runner_port),
+        cluster_version=version,
+        strategy=strategy,
+        config_server=config_server_url,
+        elastic_mode=args.elastic_mode,
+        init_progress=progress,
+    )
+    return WorkerProc(
+        name=f"{rank}/{len(cluster.workers)}",
+        argv=list(cmd),
+        env=env,
+        rank=rank,
+        logdir=args.logdir,
+        quiet=args.quiet,
+    )
+
+
+def make_worker_procs(
+    args, cmd, cluster: Cluster, self_host: str, strategy: Strategy,
+    config_server_url: str = "", version: int = 0, progress: int = 0,
+) -> List[WorkerProc]:
+    return [
+        make_one_worker_proc(
+            args, cmd, cluster, w, self_host, strategy, config_server_url,
+            version, progress,
+        )
+        for w in cluster.workers
+        if w.host == self_host
+    ]
+
+
+def simple_run(args, cmd, cluster, self_host, strategy, config_server_url="") -> int:
+    procs = make_worker_procs(args, cmd, cluster, self_host, strategy, config_server_url)
+    if args.timeout:
+        def on_alarm(sig, frame):
+            for p in procs:
+                p.kill()
+        signal.signal(signal.SIGALRM, on_alarm)
+        signal.alarm(int(args.timeout))
+    codes = run_all(procs)
+    bad = [c for c in codes if c != 0]
+    if bad:
+        print(f"kfrun: {len(bad)}/{len(codes)} workers failed", file=sys.stderr)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,108 @@
+"""Worker environment contract.
+
+Capability parity: srcs/go/kungfu/env/envs.go:4-20 + config.go:53-140 —
+the runner passes cluster topology to workers via env vars; a worker
+started without them becomes a single-process cluster of itself.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Optional
+
+from kungfu_tpu.base.strategy import DEFAULT_STRATEGY, Strategy
+from kungfu_tpu.plan.peer import PeerID, PeerList
+
+SELF_SPEC = "KF_SELF_SPEC"
+INIT_PEERS = "KF_INIT_PEERS"
+INIT_RUNNERS = "KF_INIT_RUNNERS"
+PARENT_ID = "KF_PARENT_ID"
+INIT_CLUSTER_VERSION = "KF_INIT_CLUSTER_VERSION"
+ALLREDUCE_STRATEGY = "KF_ALLREDUCE_STRATEGY"
+CONFIG_SERVER = "KF_CONFIG_SERVER"
+ELASTIC_MODE = "KF_ELASTIC_MODE"
+INIT_PROGRESS = "KF_INIT_PROGRESS"
+# tuning (parity: config/config.go:24-67)
+ENABLE_MONITORING = "KF_CONFIG_ENABLE_MONITORING"
+ENABLE_STALL_DETECTION = "KF_CONFIG_ENABLE_STALL_DETECTION"
+LOG_LEVEL = "KF_CONFIG_LOG_LEVEL"
+
+ALL_ENV_NAMES = [
+    SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,
+    ALLREDUCE_STRATEGY, CONFIG_SERVER, ELASTIC_MODE, INIT_PROGRESS,
+    ENABLE_MONITORING, ENABLE_STALL_DETECTION, LOG_LEVEL,
+]
+
+
+@dataclasses.dataclass
+class WorkerConfig:
+    self_id: PeerID
+    peers: PeerList
+    runners: PeerList
+    parent: Optional[PeerID]
+    cluster_version: int
+    strategy: Strategy
+    config_server: str
+    elastic_mode: str  # "" (delta) | "reload"
+    init_progress: int
+    single_process: bool = False
+
+
+def parse_config_from_env(environ=None) -> WorkerConfig:
+    env = environ if environ is not None else os.environ
+    self_spec = env.get(SELF_SPEC, "")
+    if not self_spec:
+        # single-process fallback (parity: config.go:131-140)
+        me = PeerID("127.0.0.1", 10000)
+        return WorkerConfig(
+            self_id=me,
+            peers=PeerList([me]),
+            runners=PeerList(),
+            parent=None,
+            cluster_version=0,
+            strategy=DEFAULT_STRATEGY,
+            config_server=env.get(CONFIG_SERVER, ""),
+            elastic_mode=env.get(ELASTIC_MODE, ""),
+            init_progress=int(env.get(INIT_PROGRESS, "0") or 0),
+            single_process=True,
+        )
+    return WorkerConfig(
+        self_id=PeerID.parse(self_spec),
+        peers=PeerList.parse(env.get(INIT_PEERS, self_spec)),
+        runners=PeerList.parse(env.get(INIT_RUNNERS, "")),
+        parent=PeerID.parse(env[PARENT_ID]) if env.get(PARENT_ID) else None,
+        cluster_version=int(env.get(INIT_CLUSTER_VERSION, "0") or 0),
+        strategy=Strategy.parse(env.get(ALLREDUCE_STRATEGY, DEFAULT_STRATEGY.name)),
+        config_server=env.get(CONFIG_SERVER, ""),
+        elastic_mode=env.get(ELASTIC_MODE, ""),
+        init_progress=int(env.get(INIT_PROGRESS, "0") or 0),
+    )
+
+
+def worker_env(
+    self_id: PeerID,
+    peers: PeerList,
+    runners: PeerList,
+    parent: PeerID,
+    cluster_version: int = 0,
+    strategy: Strategy = DEFAULT_STRATEGY,
+    config_server: str = "",
+    elastic_mode: str = "",
+    init_progress: int = 0,
+) -> dict:
+    """Env block a runner sets for a spawned worker (parity: job.go:35-80)."""
+    env = {
+        SELF_SPEC: str(self_id),
+        INIT_PEERS: ",".join(str(p) for p in peers),
+        INIT_RUNNERS: ",".join(str(r) for r in runners),
+        PARENT_ID: str(parent),
+        INIT_CLUSTER_VERSION: str(cluster_version),
+        ALLREDUCE_STRATEGY: strategy.name,
+        INIT_PROGRESS: str(init_progress),
+    }
+    if config_server:
+        env[CONFIG_SERVER] = config_server
+    if elastic_mode:
+        env[ELASTIC_MODE] = elastic_mode
+    return env

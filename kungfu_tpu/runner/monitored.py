@@ -1,0 +1,167 @@
+"""Failure detection + auto-recovery (checkpoint-restart).
+
+Capability parity: srcs/go/kungfu/runner/monitorserver/monitor.go:17-198 +
+monitored.go:18-75 — a per-host HTTP monitor receives worker heartbeats
+(``begin:<rank>`` / ``end:<rank>`` / ``epoch:<rank>`` / ``trainend:<rank>``);
+a worker that stays inside a batch longer than the grace period is declared
+stuck, all workers are killed and relaunched with ``--restart 1`` appended
+so the training script reloads its checkpoint and continues from the last
+completed epoch.
+"""
+
+from __future__ import annotations
+
+import sys
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Dict, Optional
+
+MONITOR_PORT = 7756
+DEFAULT_GRACE = 10.0
+
+
+class HeartbeatState:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.in_batch: Dict[int, float] = {}  # rank -> batch begin time
+        self.epochs: Dict[int, int] = {}
+        self.train_ended: Dict[int, bool] = {}
+
+    def signal(self, kind: str, rank: int) -> None:
+        now = time.monotonic()
+        with self._lock:
+            if kind == "begin":
+                self.in_batch[rank] = now
+            elif kind == "end":
+                self.in_batch.pop(rank, None)
+            elif kind == "epoch":
+                self.epochs[rank] = self.epochs.get(rank, 0) + 1
+            elif kind == "trainend":
+                self.train_ended[rank] = True
+                self.in_batch.pop(rank, None)
+
+    def stuck_ranks(self, grace: float):
+        now = time.monotonic()
+        with self._lock:
+            return [r for r, t0 in self.in_batch.items() if now - t0 > grace]
+
+    def min_epoch(self) -> int:
+        with self._lock:
+            return min(self.epochs.values()) if self.epochs else 0
+
+    def all_done(self, n: int) -> bool:
+        with self._lock:
+            return len(self.train_ended) >= n and all(self.train_ended.values())
+
+    def reset(self) -> None:
+        with self._lock:
+            self.in_batch.clear()
+            self.train_ended.clear()
+
+
+class MonitorServer:
+    """HTTP endpoint workers POST heartbeats to (parity: :7756 server)."""
+
+    def __init__(self, state: HeartbeatState, port: int = MONITOR_PORT):
+        self.state = state
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_POST(inner):
+                n = int(inner.headers.get("Content-Length", 0))
+                body = inner.rfile.read(n).decode().strip()
+                kind, _, rank = body.partition(":")
+                try:
+                    self.state.signal(kind, int(rank))
+                    inner.send_response(200)
+                except ValueError:
+                    inner.send_response(400)
+                inner.end_headers()
+
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    def start(self):
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
+def parse_duration(s: str) -> float:
+    s = s.strip()
+    if s.endswith("ms"):
+        return float(s[:-2]) / 1000
+    if s.endswith("s"):
+        return float(s[:-1])
+    if s.endswith("m"):
+        return float(s[:-1]) * 60
+    return float(s)
+
+
+def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
+    """Launch-and-relaunch loop (parity: MonitoredRun, monitored.go:18-75)."""
+    from kungfu_tpu.runner.cli import make_worker_procs
+
+    grace = parse_duration(args.auto_recover) if args.auto_recover else DEFAULT_GRACE
+    state = HeartbeatState()
+    monitor = MonitorServer(state, MONITOR_PORT)
+    monitor.start()
+    n_local = sum(1 for w in cluster.workers if w.host == self_host)
+    restart = 0
+    try:
+        while True:
+            worker_cmd = list(cmd)
+            if restart > 0:
+                worker_cmd += ["--restart", "1"]
+            procs = make_worker_procs(args, worker_cmd, cluster, self_host, strategy)
+            for p in procs:
+                p.start()
+            state.reset()
+            failed = False
+            while True:
+                if all(not p.running for p in procs):
+                    codes = [p.proc.returncode for p in procs]
+                    if all(c == 0 for c in codes):
+                        return 0
+                    failed = True
+                    break
+                if state.stuck_ranks(grace):
+                    print(
+                        f"kfrun: worker stuck > {grace}s at epoch {state.min_epoch()}; restarting",
+                        file=sys.stderr,
+                    )
+                    failed = True
+                    break
+                if state.all_done(n_local):
+                    for p in procs:
+                        p.wait(30)
+                    return 0
+                time.sleep(0.5)
+            for p in procs:
+                p.kill()
+            if not failed:
+                return 0
+            restart += 1
+            if restart > 100:
+                print("kfrun: too many restarts, giving up", file=sys.stderr)
+                return 1
+    finally:
+        monitor.stop()
+
+
+def send_heartbeat(kind: str, rank: int, host: str = "127.0.0.1", port: int = MONITOR_PORT) -> None:
+    """Worker-side heartbeat (parity: kungfu.cmd.monitor_batch_begin etc.)."""
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://{host}:{port}/signal", data=f"{kind}:{rank}".encode(), method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=2) as resp:
+            resp.read()
+    except OSError:
+        pass  # monitor absent: heartbeats are best-effort

@@ -1,0 +1,111 @@
+"""Worker process spawning and log streaming.
+
+Capability parity: srcs/go/proc/proc.go + srcs/go/utils/runner/local
+(parallel local exec with colored per-proc log prefixes and per-worker log
+files) and srcs/go/kungfu/job/job.go (env construction).
+"""
+
+from __future__ import annotations
+
+import os
+import subprocess
+import sys
+import threading
+from typing import Dict, List, Optional
+
+_COLORS = [31, 32, 33, 34, 35, 36, 91, 92, 93, 94, 95, 96]
+
+
+def _color(i: int, s: str) -> str:
+    if not sys.stdout.isatty():
+        return s
+    return f"\x1b[{_COLORS[i % len(_COLORS)]}m{s}\x1b[0m"
+
+
+class WorkerProc:
+    def __init__(
+        self,
+        name: str,
+        argv: List[str],
+        env: Dict[str, str],
+        rank: int = 0,
+        logdir: Optional[str] = None,
+        quiet: bool = False,
+    ):
+        self.name = name
+        self.argv = argv
+        self.env = env
+        self.rank = rank
+        self.logdir = logdir
+        self.quiet = quiet
+        self.proc: Optional[subprocess.Popen] = None
+        self._threads: List[threading.Thread] = []
+
+    def start(self) -> None:
+        full_env = dict(os.environ)
+        full_env.update(self.env)
+        self.proc = subprocess.Popen(
+            self.argv,
+            env=full_env,
+            stdout=subprocess.PIPE,
+            stderr=subprocess.PIPE,
+            text=True,
+            bufsize=1,
+        )
+        logfile = None
+        if self.logdir:
+            os.makedirs(self.logdir, exist_ok=True)
+            logfile = open(os.path.join(self.logdir, f"{self.name}.log"), "w")
+        for stream, tag in ((self.proc.stdout, ""), (self.proc.stderr, "!")):
+            t = threading.Thread(
+                target=self._pump, args=(stream, tag, logfile), daemon=True
+            )
+            t.start()
+            self._threads.append(t)
+
+    def _pump(self, stream, tag: str, logfile) -> None:
+        prefix = _color(self.rank, f"[{self.name}{tag}] ")
+        for line in stream:
+            if logfile:
+                logfile.write(f"[{tag or ' '}] {line}")
+                logfile.flush()
+            if not self.quiet:
+                sys.stdout.write(prefix + line)
+                sys.stdout.flush()
+
+    def wait(self, timeout: Optional[float] = None) -> int:
+        rc = self.proc.wait(timeout)
+        for t in self._threads:
+            t.join(1)
+        return rc
+
+    def kill(self) -> None:
+        if self.proc and self.proc.poll() is None:
+            self.proc.terminate()
+            try:
+                self.proc.wait(5)
+            except subprocess.TimeoutExpired:
+                self.proc.kill()
+
+    @property
+    def running(self) -> bool:
+        return self.proc is not None and self.proc.poll() is None
+
+
+def run_all(procs: List[WorkerProc]) -> List[int]:
+    """Start all procs and wait; on first failure kill the rest (parity:
+    local.RunAll semantics)."""
+    for p in procs:
+        p.start()
+    codes = [None] * len(procs)
+    try:
+        for i, p in enumerate(procs):
+            codes[i] = p.wait()
+    except KeyboardInterrupt:
+        for p in procs:
+            p.kill()
+        raise
+    if any(c != 0 for c in codes):
+        for p in procs:
+            p.kill()
+    return [c if c is not None else -1 for c in codes]

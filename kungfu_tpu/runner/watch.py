@@ -1,0 +1,147 @@
+"""Elastic watcher: runner-side supervisor for membership changes.
+
+Capability parity: srcs/go/kungfu/runner/watch.go:24-171 + handler.go —
+the runner hosts a control endpoint; workers send Stage{Version, Progress,
+Cluster} updates during a resize. The watcher diffs the local worker set:
+waits removed procs, spawns added ones (delta mode), or restarts everything
+from the carried progress (reload mode). Duplicate versions are deduped;
+inconsistent duplicates abort (handler.go:90-103 safety check).
+"""
+
+from __future__ import annotations
+
+import json
+import queue
+import sys
+import threading
+import time
+from typing import Dict, List, Optional
+
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.peer import PeerID, PeerList
+from kungfu_tpu.runner.proc import WorkerProc
+from kungfu_tpu.transport.message import ConnType, Message
+from kungfu_tpu.transport.server import Server
+
+
+class Stage:
+    def __init__(self, version: int, progress: int, cluster: Cluster, reload: bool = False):
+        self.version = version
+        self.progress = progress
+        self.cluster = cluster
+        self.reload = reload
+
+    @classmethod
+    def from_json(cls, obj: dict) -> "Stage":
+        return cls(
+            version=int(obj["Version"]),
+            progress=int(obj.get("Progress", 0)),
+            cluster=Cluster.from_json(obj["Cluster"]),
+            reload=bool(obj.get("Reload", False)),
+        )
+
+    def digest(self) -> bytes:
+        return self.cluster.digest() + str(self.version).encode()
+
+
+class Watcher:
+    def __init__(self, args, cmd, self_host: str, strategy, config_server_url: str):
+        self.args = args
+        self.cmd = cmd
+        self.self_host = self_host
+        self.strategy = strategy
+        self.config_server_url = config_server_url
+        self.stage_q: "queue.Queue[Stage]" = queue.Queue()
+        self.current: Dict[PeerID, WorkerProc] = {}
+        self.seen_versions: Dict[int, bytes] = {}
+        self.done = threading.Event()
+        self.exit_code = 0
+        self._gone: List[WorkerProc] = []
+
+    # -- control endpoint ----------------------------------------------
+    def handle_control(self, src: PeerID, msg: Message) -> None:
+        if msg.name == "exit":
+            self.done.set()
+            return
+        if msg.name != "update":
+            return
+        stage = Stage.from_json(json.loads(msg.data.decode()))
+        digest = stage.digest()
+        if stage.version in self.seen_versions:
+            if self.seen_versions[stage.version] != digest:
+                # diverged proposals for the same version: unrecoverable
+                print(
+                    f"kfrun: inconsistent cluster for version {stage.version}; aborting",
+                    file=sys.stderr,
+                )
+                self.exit_code = 1
+                self.done.set()
+            return
+        self.seen_versions[stage.version] = digest
+        self.stage_q.put(stage)
+
+    # -- proc management -----------------------------------------------
+    def _spawn(self, w: PeerID, stage: Stage) -> None:
+        from kungfu_tpu.runner.cli import make_one_worker_proc
+
+        p = make_one_worker_proc(
+            self.args, self.cmd, stage.cluster, w, self.self_host, self.strategy,
+            self.config_server_url, version=stage.version, progress=stage.progress,
+        )
+        p.start()
+        self.current[w] = p
+
+    def apply_delta(self, stage: Stage) -> None:
+        new_local = {w for w in stage.cluster.workers if w.host == self.self_host}
+        old_local = set(self.current)
+        for w in old_local - new_local:
+            proc = self.current.pop(w)
+            self._gone.append(proc)  # worker exits itself on detach
+        for w in sorted(new_local - old_local):
+            self._spawn(w, stage)
+
+    def apply_full(self, stage: Stage) -> None:
+        """Reload mode: stop everything, restart from stage.progress."""
+        for w, proc in list(self.current.items()):
+            proc.kill()
+        self.current.clear()
+        for w in stage.cluster.workers:
+            if w.host == self.self_host:
+                self._spawn(w, stage)
+
+    def run(self, initial: Stage) -> int:
+        server = Server(PeerID(self.self_host, self.args.runner_port), use_unix=False)
+        server.register(ConnType.CONTROL, self.handle_control)
+        server.start()
+        try:
+            self.apply_delta(initial)
+            while not self.done.is_set():
+                try:
+                    stage = self.stage_q.get(timeout=0.5)
+                except queue.Empty:
+                    # exit when all local workers have finished
+                    if self.current and all(not p.running for p in self.current.values()):
+                        codes = [p.proc.returncode for p in self.current.values()]
+                        self.exit_code = 0 if all(c == 0 for c in codes) else 1
+                        break
+                    # reap detached workers
+                    self._gone = [p for p in self._gone if p.running]
+                    continue
+                if stage.reload:
+                    self.apply_full(stage)
+                else:
+                    self.apply_delta(stage)
+            return self.exit_code
+        finally:
+            for p in self.current.values():
+                p.kill()
+            for p in self._gone:
+                p.kill()
+            server.stop()
+
+
+def watch_run(args, cmd, cluster: Cluster, self_host: str, strategy, config_server_url: str) -> int:
+    watcher = Watcher(args, cmd, self_host, strategy, config_server_url)
+    initial = Stage(version=0, progress=0, cluster=cluster)
+    watcher.seen_versions[0] = initial.digest()
+    return watcher.run(initial)

@@ -1,0 +1,3 @@
+from kungfu_tpu.store.versioned import BlobStore, VersionedStore
+
+__all__ = ["BlobStore", "VersionedStore"]

@@ -1,0 +1,5 @@
+from kungfu_tpu.transport.message import ConnType, Flags, Message
+from kungfu_tpu.transport.client import Client
+from kungfu_tpu.transport.server import Server
+
+__all__ = ["Client", "ConnType", "Flags", "Message", "Server"]

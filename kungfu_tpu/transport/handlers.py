@@ -1,0 +1,128 @@
+"""Endpoint handlers: collective rendezvous, p2p store, queues, control.
+
+Capability parity: srcs/go/rchannel/handler/{collective,p2p,queue}.go —
+- CollectiveEndpoint: named rendezvous queues; Recv blocks until a message
+  with that name arrives (graph-walk collectives pair send/recv by name).
+- PeerToPeerEndpoint: request/response over a versioned blob store (the
+  PairAveraging model exchange).
+- QueueHandler: named FIFO queues between peers.
+- ControlHandler: delivers cluster Stage updates to a callback.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import threading
+from collections import defaultdict, deque
+from typing import Callable, Dict, Optional, Tuple
+
+from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.transport.message import ConnType, Flags, Message
+
+
+class _Rendezvous:
+    """A blocking mailbox per (src, name)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._boxes: Dict[Tuple[PeerID, str], deque] = defaultdict(deque)
+
+    def put(self, src: PeerID, msg: Message) -> None:
+        with self._cond:
+            self._boxes[(src, msg.name)].append(msg)
+            self._cond.notify_all()
+
+    def get(self, src: PeerID, name: str, timeout: Optional[float] = None) -> Message:
+        key = (src, name)
+        with self._cond:
+            ok = self._cond.wait_for(lambda: len(self._boxes.get(key, ())) > 0, timeout)
+            if not ok:
+                raise TimeoutError(f"recv timeout: {name} from {src}")
+            box = self._boxes[key]
+            msg = box.popleft()
+            if not box:
+                # names are version/chunk-tagged: drop drained mailboxes so
+                # long elastic runs don't accumulate dead keys
+                del self._boxes[key]
+            return msg
+
+
+class CollectiveEndpoint:
+    """Named rendezvous for graph-walk collectives."""
+
+    def __init__(self):
+        self._rdv = _Rendezvous()
+
+    def handle(self, src: PeerID, msg: Message) -> None:
+        self._rdv.put(src, msg)
+
+    def recv(self, src: PeerID, name: str, timeout: Optional[float] = None) -> Message:
+        return self._rdv.get(src, name, timeout)
+
+
+class QueueEndpoint:
+    """Named FIFO queues (parity: handler/queue.go)."""
+
+    def __init__(self):
+        self._rdv = _Rendezvous()
+
+    def handle(self, src: PeerID, msg: Message) -> None:
+        self._rdv.put(src, msg)
+
+    def get(self, src: PeerID, name: str, timeout: Optional[float] = None) -> bytes:
+        return self._rdv.get(src, name, timeout).data
+
+
+class ControlEndpoint:
+    """Control messages (cluster updates / exit); parity:
+    srcs/go/kungfu/runner/handler.go. The callback runs on the transport
+    thread — keep it short."""
+
+    def __init__(self, callback: Callable[[PeerID, Message], None]):
+        self._callback = callback
+
+    def handle(self, src: PeerID, msg: Message) -> None:
+        self._callback(src, msg)
+
+
+class P2PEndpoint:
+    """Request/response over a versioned blob store.
+
+    Parity: srcs/go/rchannel/handler/p2p.go:13-121. Requests name a blob
+    (and optionally a version); the remote endpoint reads it from its store
+    and sends it back flagged IS_RESPONSE (REQUEST_FAILED when absent).
+    """
+
+    def __init__(self, store, client, self_id: PeerID):
+        self.store = store
+        self.client = client
+        self.self_id = self_id
+        self._rdv = _Rendezvous()
+
+    def handle(self, src: PeerID, msg: Message) -> None:
+        if msg.flags & Flags.IS_RESPONSE:
+            self._rdv.put(src, msg)
+            return
+        # incoming request: look up blob, respond
+        data = self.store.get(msg.name)
+        if data is None:
+            self.client.send(
+                src, msg.name, b"", ConnType.PEER_TO_PEER,
+                Flags.IS_RESPONSE | Flags.REQUEST_FAILED,
+            )
+        else:
+            self.client.send(
+                src, msg.name, data, ConnType.PEER_TO_PEER, Flags.IS_RESPONSE
+            )
+
+    def request(self, peer: PeerID, name: str, timeout: float = 30.0) -> Optional[bytes]:
+        """Fetch `name` from peer's store; None if the peer doesn't have it."""
+        self.client.send(peer, name, b"", ConnType.PEER_TO_PEER, Flags.NONE)
+        msg = self._rdv.get(peer, name, timeout)
+        if msg.flags & Flags.REQUEST_FAILED:
+            return None
+        return msg.data
+
+    def save(self, name: str, data: bytes) -> None:
+        self.store.put(name, data)

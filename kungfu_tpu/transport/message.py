@@ -1,0 +1,102 @@
+"""Wire protocol: framed named messages over TCP/Unix sockets.
+
+Capability parity: srcs/go/rchannel/connection/message.go — connection
+header {type, source identity} + token ack; messages are
+{name}{flags}{payload} frames; connection types demux to different
+handlers (message.go:12-18, :45-68, :80-213).
+
+The DCN control plane uses this for: control messages (cluster updates),
+consensus/barrier collectives, p2p weight-store requests, and queues.
+Device data NEVER flows here — that is ICI/XLA territory.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import enum
+import socket
+import struct
+
+MAGIC = 0x4B465450  # "KFTP"
+
+
+class ConnType(enum.IntEnum):
+    PING = 0
+    CONTROL = 1
+    COLLECTIVE = 2
+    PEER_TO_PEER = 3
+    QUEUE = 4
+
+
+class Flags(enum.IntFlag):
+    NONE = 0
+    WAIT_RECV_BUF = 1  # receiver must deliver into a registered buffer
+    IS_RESPONSE = 2
+    REQUEST_FAILED = 4
+
+
+@dataclasses.dataclass
+class Message:
+    name: str
+    data: bytes
+    flags: Flags = Flags.NONE
+
+
+# ---------------------------------------------------------------------------
+# framing
+# ---------------------------------------------------------------------------
+
+_HEADER = struct.Struct("<IBHI")  # magic, conn_type, src_port, token
+_FRAME = struct.Struct("<III")  # name_len, flags, data_len
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytes:
+    buf = bytearray(n)
+    view = memoryview(buf)
+    got = 0
+    while got < n:
+        r = sock.recv_into(view[got:], n - got)
+        if r == 0:
+            raise ConnectionError("peer closed connection")
+        got += r
+    return bytes(buf)
+
+
+def send_header(sock: socket.socket, conn_type: ConnType, src_host: str, src_port: int, token: int) -> None:
+    host_b = src_host.encode()
+    sock.sendall(_HEADER.pack(MAGIC, int(conn_type), src_port, token)
+                 + struct.pack("<H", len(host_b)) + host_b)
+
+
+def recv_header(sock: socket.socket):
+    """Returns (conn_type, src_host, src_port, token)."""
+    magic, conn_type, src_port, token = _HEADER.unpack(_recv_exact(sock, _HEADER.size))
+    if magic != MAGIC:
+        raise ConnectionError(f"bad magic: {magic:#x}")
+    (host_len,) = struct.unpack("<H", _recv_exact(sock, 2))
+    host = _recv_exact(sock, host_len).decode()
+    return ConnType(conn_type), host, src_port, token
+
+
+def send_ack(sock: socket.socket, token: int) -> None:
+    sock.sendall(struct.pack("<I", token))
+
+
+def recv_ack(sock: socket.socket) -> int:
+    (token,) = struct.unpack("<I", _recv_exact(sock, 4))
+    return token
+
+
+def send_message(sock: socket.socket, msg: Message) -> None:
+    name_b = msg.name.encode()
+    sock.sendall(_FRAME.pack(len(name_b), int(msg.flags), len(msg.data)))
+    sock.sendall(name_b)
+    if msg.data:
+        sock.sendall(msg.data)
+
+
+def recv_message(sock: socket.socket) -> Message:
+    name_len, flags, data_len = _FRAME.unpack(_recv_exact(sock, _FRAME.size))
+    name = _recv_exact(sock, name_len).decode()
+    data = _recv_exact(sock, data_len) if data_len else b""
+    return Message(name=name, data=data, flags=Flags(flags))

@@ -1,0 +1,133 @@
+"""Transport server: listens for peer connections, demuxes to handlers.
+
+Capability parity: srcs/go/rchannel/server/server.go (TCP + Unix-socket
+listener for colocated peers) and srcs/go/kungfu/peer/router.go (demux by
+ConnType). Token-versioned connections: after an elastic resize bumps the
+cluster version, stale connections (old token) are rejected so a new epoch
+never consumes old-epoch frames (parity: server.SetToken +
+router.ResetConnections, peer/peer.go:148-160).
+"""
+
+from __future__ import annotations
+
+import os
+import socket
+import threading
+from typing import Callable, Dict, Optional
+
+from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.transport.message import (
+    ConnType,
+    Message,
+    recv_header,
+    recv_message,
+    send_ack,
+)
+
+# handler(src: PeerID, msg: Message) -> None
+Handler = Callable[[PeerID, Message], None]
+
+
+def unix_sock_path(peer: PeerID) -> str:
+    return f"/tmp/kungfu_tpu-{peer.port}.sock"
+
+
+class Server:
+    def __init__(self, self_id: PeerID, use_unix: bool = True):
+        self.self_id = self_id
+        self._handlers: Dict[ConnType, Handler] = {}
+        self._token = 0
+        self._lock = threading.Lock()
+        self._listeners = []
+        self._threads = []
+        self._stopped = threading.Event()
+        self._use_unix = use_unix
+
+    def register(self, conn_type: ConnType, handler: Handler) -> None:
+        self._handlers[conn_type] = handler
+
+    def set_token(self, token: int) -> None:
+        with self._lock:
+            self._token = token
+
+    @property
+    def token(self) -> int:
+        with self._lock:
+            return self._token
+
+    def start(self) -> None:
+        tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+        tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        tcp.bind(("0.0.0.0", self.self_id.port))
+        tcp.listen(128)
+        self._listeners.append(tcp)
+        t = threading.Thread(target=self._accept_loop, args=(tcp,), daemon=True)
+        t.start()
+        self._threads.append(t)
+
+        if self._use_unix:
+            path = unix_sock_path(self.self_id)
+            try:
+                os.unlink(path)
+            except FileNotFoundError:
+                pass
+            ux = socket.socket(socket.AF_UNIX, socket.SOCK_STREAM)
+            ux.bind(path)
+            ux.listen(128)
+            self._listeners.append(ux)
+            t2 = threading.Thread(target=self._accept_loop, args=(ux,), daemon=True)
+            t2.start()
+            self._threads.append(t2)
+
+    def stop(self) -> None:
+        self._stopped.set()
+        for l in self._listeners:
+            try:
+                l.close()
+            except OSError:
+                pass
+        if self._use_unix:
+            try:
+                os.unlink(unix_sock_path(self.self_id))
+            except FileNotFoundError:
+                pass
+
+    def _accept_loop(self, listener: socket.socket) -> None:
+        while not self._stopped.is_set():
+            try:
+                conn, _ = listener.accept()
+            except OSError:
+                return
+            threading.Thread(
+                target=self._serve_conn, args=(conn,), daemon=True
+            ).start()
+
+    def _serve_conn(self, conn: socket.socket) -> None:
+        try:
+            conn_type, src_host, src_port, token = recv_header(conn)
+            # Token check: PING and CONTROL are version-independent (they
+            # carry the resize protocol itself); data-plane types must match
+            # the current epoch.
+            if conn_type in (ConnType.COLLECTIVE, ConnType.PEER_TO_PEER, ConnType.QUEUE):
+                if token != self.token:
+                    conn.close()
+                    return
+            send_ack(conn, self.token)
+            src = PeerID(src_host, src_port)
+            handler = self._handlers.get(conn_type)
+            if conn_type == ConnType.PING:
+                conn.close()
+                return
+            if handler is None:
+                conn.close()
+                return
+            while not self._stopped.is_set():
+                msg = recv_message(conn)
+                handler(src, msg)
+        except (ConnectionError, OSError):
+            pass
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass

@@ -1,0 +1,45 @@
+"""Stall detector: liveness watchdog around blocking operations.
+
+Capability parity: srcs/go/utils/stalldetector.go:15-46 — any guarded
+operation that runs longer than the period logs "X stalled for Ns"
+repeatedly until it completes; enabled by KF_CONFIG_ENABLE_STALL_DETECTION
+around collective calls and resize paths (libkungfu-comm/main.go:179-190).
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import sys
+import threading
+import time
+
+DEFAULT_PERIOD = 3.0
+
+
+def enabled() -> bool:
+    return os.environ.get("KF_CONFIG_ENABLE_STALL_DETECTION", "") in ("1", "true")
+
+
+@contextlib.contextmanager
+def stall_detect(name: str, period: float = DEFAULT_PERIOD, force: bool = False):
+    """Context manager: while the body runs, log every `period` seconds."""
+    if not (force or enabled()):
+        yield
+        return
+    done = threading.Event()
+    t0 = time.monotonic()
+
+    def watch():
+        n = 0
+        while not done.wait(period):
+            n += 1
+            elapsed = time.monotonic() - t0
+            print(f"kungfu_tpu: {name} stalled for {elapsed:.1f}s", file=sys.stderr)
+
+    watcher = threading.Thread(target=watch, daemon=True)
+    watcher.start()
+    try:
+        yield
+    finally:
+        done.set()

@@ -1,0 +1,9 @@
+#!/bin/sh
+# Build the native host-side kernels into kungfu_tpu/base/.
+# Usage: native/build.sh [CXX]
+set -e
+cd "$(dirname "$0")"
+CXX=${1:-g++}
+OUT=../kungfu_tpu/base/libkfnative.so
+$CXX -O3 -march=native -shared -fPIC -std=c++17 -o "$OUT" reduce.cpp
+echo "built $OUT"

@@ -1,0 +1,149 @@
+// Host-side SIMD reduction kernel for the DCN collective engine.
+//
+// Capability parity: the reference's std_transform_2 dispatch
+// (srcs/go/kungfu/base/op.cpp) with F16C-accelerated float16
+// (srcs/go/kungfu/base/f16.c). Used by kungfu_tpu.base.ops.transform2 via
+// ctypes; auto-vectorized by -O3 -march=native (bf16 handled as widened
+// float ops — no AVX512-BF16 assumption).
+//
+// ABI: kf_transform2(dst, x, y, count, dtype, op) -> 0 ok / -1 unsupported.
+// dtype codes match kungfu_tpu.base.dtype.DType; op codes ReduceOp.
+
+#include <cstdint>
+#include <cstddef>
+
+namespace {
+
+enum DTypeCode : int32_t {
+  U8 = 1, I8 = 2, I16 = 3, I32 = 4, I64 = 5,
+  U16 = 6, U32 = 7, U64 = 8,
+  F16 = 9, BF16 = 10, F32 = 11, F64 = 12,
+};
+
+enum OpCode : int32_t { SUM = 0, MIN = 1, MAX = 2, PROD = 3 };
+
+template <typename T, typename Op>
+void apply(T *dst, const T *x, const T *y, size_t n, Op op) {
+  for (size_t i = 0; i < n; ++i) dst[i] = op(x[i], y[i]);
+}
+
+template <typename T>
+int run(T *dst, const T *x, const T *y, size_t n, int32_t op) {
+  switch (op) {
+    case SUM:  apply(dst, x, y, n, [](T a, T b) { return static_cast<T>(a + b); }); return 0;
+    case MIN:  apply(dst, x, y, n, [](T a, T b) { return a < b ? a : b; }); return 0;
+    case MAX:  apply(dst, x, y, n, [](T a, T b) { return a > b ? a : b; }); return 0;
+    case PROD: apply(dst, x, y, n, [](T a, T b) { return static_cast<T>(a * b); }); return 0;
+  }
+  return -1;
+}
+
+// --- 16-bit float formats, widened to f32 lane-wise --------------------
+
+inline float half_to_float(uint16_t h) {
+  uint32_t sign = (uint32_t)(h >> 15) << 31;
+  uint32_t exp = (h >> 10) & 0x1f;
+  uint32_t man = h & 0x3ff;
+  uint32_t bits;
+  if (exp == 0) {
+    if (man == 0) {
+      bits = sign;
+    } else {  // subnormal
+      exp = 127 - 15 + 1;
+      while (!(man & 0x400)) { man <<= 1; --exp; }
+      man &= 0x3ff;
+      bits = sign | (exp << 23) | (man << 13);
+    }
+  } else if (exp == 0x1f) {
+    bits = sign | 0x7f800000u | (man << 13);
+  } else {
+    bits = sign | ((exp - 15 + 127) << 23) | (man << 13);
+  }
+  float out;
+  __builtin_memcpy(&out, &bits, 4);
+  return out;
+}
+
+inline uint16_t float_to_half(float f) {
+  uint32_t bits;
+  __builtin_memcpy(&bits, &f, 4);
+  uint16_t sign = (uint16_t)((bits >> 16) & 0x8000u);
+  int32_t exp = (int32_t)((bits >> 23) & 0xff) - 127 + 15;
+  uint32_t man = bits & 0x7fffffu;
+  if (exp >= 0x1f) return (uint16_t)(sign | 0x7c00u | ((((bits >> 23) & 0xff) == 0xff && man) ? 0x200 : 0));
+  if (exp <= 0) {
+    if (exp < -10) return sign;
+    man |= 0x800000u;
+    uint32_t shift = (uint32_t)(14 - exp);
+    uint16_t h = (uint16_t)(sign | (man >> shift));
+    if ((man >> (shift - 1)) & 1) h = (uint16_t)(h + 1);  // round-to-nearest
+    return h;
+  }
+  uint16_t h = (uint16_t)(sign | ((uint32_t)exp << 10) | (man >> 13));
+  if (man & 0x1000u) h = (uint16_t)(h + 1);
+  return h;
+}
+
+inline float bf16_to_float(uint16_t b) {
+  uint32_t bits = (uint32_t)b << 16;
+  float out;
+  __builtin_memcpy(&out, &bits, 4);
+  return out;
+}
+
+inline uint16_t float_to_bf16(float f) {
+  uint32_t bits;
+  __builtin_memcpy(&bits, &f, 4);
+  // round-to-nearest-even
+  uint32_t rounding = 0x7fffu + ((bits >> 16) & 1);
+  return (uint16_t)((bits + rounding) >> 16);
+}
+
+template <float (*Load)(uint16_t), uint16_t (*Store)(float)>
+int run16(uint16_t *dst, const uint16_t *x, const uint16_t *y, size_t n, int32_t op) {
+  switch (op) {
+    case SUM:
+      for (size_t i = 0; i < n; ++i) dst[i] = Store(Load(x[i]) + Load(y[i]));
+      return 0;
+    case MIN:
+      for (size_t i = 0; i < n; ++i) {
+        float a = Load(x[i]), b = Load(y[i]);
+        dst[i] = Store(a < b ? a : b);
+      }
+      return 0;
+    case MAX:
+      for (size_t i = 0; i < n; ++i) {
+        float a = Load(x[i]), b = Load(y[i]);
+        dst[i] = Store(a > b ? a : b);
+      }
+      return 0;
+    case PROD:
+      for (size_t i = 0; i < n; ++i) dst[i] = Store(Load(x[i]) * Load(y[i]));
+      return 0;
+  }
+  return -1;
+}
+
+}  // namespace
+
+extern "C" int kf_transform2(void *dst, const void *x, const void *y,
+                             int64_t count, int32_t dtype, int32_t op) {
+  size_t n = (size_t)count;
+  switch (dtype) {
+    case U8:  return run((uint8_t *)dst, (const uint8_t *)x, (const uint8_t *)y, n, op);
+    case I8:  return run((int8_t *)dst, (const int8_t *)x, (const int8_t *)y, n, op);
+    case I16: return run((int16_t *)dst, (const int16_t *)x, (const int16_t *)y, n, op);
+    case I32: return run((int32_t *)dst, (const int32_t *)x, (const int32_t *)y, n, op);
+    case I64: return run((int64_t *)dst, (const int64_t *)x, (const int64_t *)y, n, op);
+    case U16: return run((uint16_t *)dst, (const uint16_t *)x, (const uint16_t *)y, n, op);
+    case U32: return run((uint32_t *)dst, (const uint32_t *)x, (const uint32_t *)y, n, op);
+    case U64: return run((uint64_t *)dst, (const uint64_t *)x, (const uint64_t *)y, n, op);
+    case F16: return run16<half_to_float, float_to_half>(
+        (uint16_t *)dst, (const uint16_t *)x, (const uint16_t *)y, n, op);
+    case BF16: return run16<bf16_to_float, float_to_bf16>(
+        (uint16_t *)dst, (const uint16_t *)x, (const uint16_t *)y, n, op);
+    case F32: return run((float *)dst, (const float *)x, (const float *)y, n, op);
+    case F64: return run((double *)dst, (const double *)x, (const double *)y, n, op);
+  }
+  return -1;
+}

@@ -1,16 +1,21 @@
-"""Test config: force an 8-device virtual CPU mesh before jax imports.
+"""Test config: force an 8-device virtual CPU mesh before the backend starts.
 
 Mirrors the reference's multi-process-on-localhost test strategy
 (SURVEY.md §4): we get multi-chip semantics on one machine via XLA's
 host-platform device partitioning instead of kungfu-run subprocesses
 (those are exercised separately in the integration tests).
+
+Note: a pytest plugin imports jax before this file runs, so plain env vars
+are too late; jax.config.update works until the backend is initialized.
 """
 
 import os
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
-xla_flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in xla_flags:
-    os.environ["XLA_FLAGS"] = (
-        xla_flags + " --xla_force_host_platform_device_count=8"
-    ).strip()
+os.environ["XLA_FLAGS"] = (
+    os.environ.get("XLA_FLAGS", "") + " --xla_force_host_platform_device_count=8"
+).strip()
+
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+jax.config.update("jax_num_cpu_devices", 8)

@@ -1,0 +1,34 @@
+"""Elastic worker: drives ElasticState through config-server resizes.
+
+Parity: tests/python/integration/test_elastic_reload.py:17-47 — rank 0
+proposes a new cluster size every 10 steps; all workers resize via the
+config server with consensus; new workers join and sync progress; removed
+workers detach and exit cleanly.
+"""
+
+import sys
+
+from kungfu_tpu import api
+from kungfu_tpu.elastic.state import ElasticState
+
+SIZES = [2, 3, 1, 4]
+MAX_PROGRESS = 40
+
+
+def main() -> int:
+    es = ElasticState(max_progress=MAX_PROGRESS)
+    while not es.stopped():
+        with es.scope():
+            rank = api.current_rank()
+            size = api.cluster_size()
+            if es.progress > 0 and es.progress % 10 == 0 and rank == 0:
+                target = SIZES[(es.progress // 10) % len(SIZES)]
+                if target != size:
+                    api.propose_new_size(target)
+            es.end(1)
+    print(f"stopped reason={es.stop_reason} progress={es.progress}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

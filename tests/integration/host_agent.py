@@ -1,0 +1,59 @@
+"""Fake-agent worker: exercises host-plane collectives under kfrun.
+
+Parity: tests/go/cmd/kungfu-fake-go-trainer + test-p2p-apis — run under a
+localhost multi-process cluster across the strategy x np matrix
+(scripts/tests/run-integration-tests.sh:30-38).
+"""
+
+import sys
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.base.ops import ReduceOp
+
+
+def main() -> int:
+    rank = api.current_rank()
+    size = api.cluster_size()
+    expected = size * (size + 1) / 2
+
+    # small allreduce
+    out = api.all_reduce_array(np.full(1000, rank + 1, np.float32))
+    assert np.all(out == expected), f"small allreduce wrong: {out[:4]}"
+
+    # >1MiB buffer: exercises chunking + multi-root striping
+    big = np.full(1_300_000, float(rank + 1), np.float32)
+    out = api.all_reduce_array(big, name="big")
+    assert np.all(out == expected), f"big allreduce wrong: {out[:4]}"
+
+    # min/max
+    mn = api.all_reduce_array(np.array([rank], np.int64), ReduceOp.MIN, "mn")
+    mx = api.all_reduce_array(np.array([rank], np.int64), ReduceOp.MAX, "mx")
+    assert mn[0] == 0 and mx[0] == size - 1
+
+    assert api.all_reduce_int_max(rank) == size - 1
+
+    # consensus
+    assert api.consensus(b"same-bytes", "agree")
+    if size > 1:
+        assert not api.consensus(bytes([rank]), "disagree")
+        assert not api.consensus(b"x" * (rank + 1), "difflen")
+
+    api.run_barrier()
+
+    # p2p save/request ring
+    api.save("blob", bytes([rank] * 8))
+    api.run_barrier()
+    other = (rank + 1) % size
+    got = api.request(other, "blob")
+    assert got == bytes([other] * 8), f"p2p wrong from {other}: {got!r}"
+    assert api.request(other, "no-such-blob") is None
+
+    api.run_barrier()
+    print(f"OK rank={rank}/{size}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,109 @@
+"""Config server REST tests; mirrors configserver semantics
+(srcs/go/kungfu/elastic/configserver/configserver.go)."""
+
+import json
+import urllib.error
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.elastic.configserver import ConfigServer
+from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.plan.peer import PeerList
+
+
+@pytest.fixture
+def server():
+    cluster = Cluster(
+        runners=PeerList.parse("127.0.0.1:38080"),
+        workers=PeerList.parse("127.0.0.1:38000,127.0.0.1:38001"),
+    )
+    srv = ConfigServer(0, cluster, host="127.0.0.1")
+    srv.start()
+    yield srv
+    srv.stop()
+
+
+def url(srv, path="/config"):
+    return f"http://127.0.0.1:{srv.port}{path}"
+
+
+def get_json(u):
+    with urllib.request.urlopen(u, timeout=5) as r:
+        return json.loads(r.read().decode())
+
+
+def test_get_initial(server):
+    obj = get_json(url(server))
+    assert len(obj["Workers"]) == 2
+    assert obj["Version"] == 0
+
+
+def test_put_new_cluster(server):
+    new = Cluster(
+        runners=PeerList.parse("127.0.0.1:38080"),
+        workers=PeerList.parse("127.0.0.1:38000,127.0.0.1:38001,127.0.0.1:38002"),
+    )
+    req = urllib.request.Request(url(server), data=new.dumps().encode(), method="PUT")
+    with urllib.request.urlopen(req, timeout=5) as r:
+        assert json.loads(r.read())["Version"] == 1
+    obj = get_json(url(server))
+    assert len(obj["Workers"]) == 3
+    assert obj["Version"] == 1
+
+
+def test_put_invalid_cluster_rejected(server):
+    bad = {"Runners": [], "Workers": ["10.0.0.9:38000"]}  # worker without runner
+    req = urllib.request.Request(
+        url(server), data=json.dumps(bad).encode(), method="PUT"
+    )
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(req, timeout=5)
+    assert e.value.code == 400
+    # state unchanged
+    assert len(get_json(url(server))["Workers"]) == 2
+
+
+def test_delete_then_404(server):
+    req = urllib.request.Request(url(server), method="DELETE")
+    urllib.request.urlopen(req, timeout=5)
+    with pytest.raises(urllib.error.HTTPError) as e:
+        urllib.request.urlopen(url(server), timeout=5)
+    assert e.value.code == 404
+
+
+def test_env_contract_roundtrip():
+    from kungfu_tpu.base.strategy import Strategy
+    from kungfu_tpu.plan.peer import PeerID
+    from kungfu_tpu.runner import env as kfenv
+
+    peers = PeerList.parse("127.0.0.1:38000,127.0.0.1:38001")
+    runners = PeerList.parse("127.0.0.1:38080")
+    env = kfenv.worker_env(
+        self_id=peers[1],
+        peers=peers,
+        runners=runners,
+        parent=runners[0],
+        cluster_version=7,
+        strategy=Strategy.RING,
+        config_server="http://x/config",
+        elastic_mode="reload",
+        init_progress=1234,
+    )
+    cfg = kfenv.parse_config_from_env(env)
+    assert cfg.self_id == PeerID("127.0.0.1", 38001)
+    assert cfg.peers == peers
+    assert cfg.runners == runners
+    assert cfg.cluster_version == 7
+    assert cfg.strategy == Strategy.RING
+    assert cfg.elastic_mode == "reload"
+    assert cfg.init_progress == 1234
+    assert not cfg.single_process
+
+
+def test_env_single_process_fallback():
+    from kungfu_tpu.runner import env as kfenv
+
+    cfg = kfenv.parse_config_from_env({})
+    assert cfg.single_process
+    assert len(cfg.peers) == 1

@@ -1,0 +1,37 @@
+"""Elastic resize end-to-end: kfrun -w + builtin config server.
+
+Parity: scripts/tests/run-elastic-test.sh — a schedule of cluster sizes is
+driven through the config server while training progresses; the run must
+finish with progress complete and all procs exited cleanly.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AGENT = os.path.join(REPO, "tests", "integration", "elastic_agent.py")
+
+
+def test_elastic_resize_schedule():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2",
+            "-H", "127.0.0.1:4",
+            "-w",
+            "-builtin-config-port", "0",
+            "-q",
+            "--", sys.executable, AGENT,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=220,
+        cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"

@@ -1,0 +1,66 @@
+"""Multi-process localhost integration: kfrun x strategy x np matrix.
+
+Parity: scripts/tests/run-integration-tests.sh — every strategy must give
+correct collectives on real multi-process clusters.
+"""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AGENT = os.path.join(REPO, "tests", "integration", "host_agent.py")
+
+
+def run_kfrun(np_, strategy, extra_env=None, timeout=120):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if extra_env:
+        env.update(extra_env)
+    return subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", str(np_),
+            "-H", f"127.0.0.1:{np_}",
+            "-strategy", strategy,
+            "-q",
+            "--", sys.executable, AGENT,
+        ],
+        env=env,
+        capture_output=True,
+        text=True,
+        timeout=timeout,
+        cwd=REPO,
+    )
+
+
+@pytest.mark.parametrize("np_", [1, 2, 4])
+def test_kfrun_matrix_default(np_):
+    r = run_kfrun(np_, "AUTO")
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+@pytest.mark.parametrize(
+    "strategy",
+    ["STAR", "RING", "CLIQUE", "BINARY_TREE", "BINARY_TREE_STAR", "TREE",
+     "MULTI_STAR", "MULTI_BINARY_TREE_STAR"],
+)
+def test_kfrun_all_strategies_np4(strategy):
+    r = run_kfrun(4, strategy)
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+def test_kfrun_propagates_worker_failure():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2", "-q",
+            "--", sys.executable, "-c", "import sys; sys.exit(3)",
+        ],
+        env=env, capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert r.returncode == 1

@@ -1,0 +1,140 @@
+"""Native kernel + aux subsystem tests (stall detector, net monitor, policy)."""
+
+import os
+import subprocess
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+LIB = os.path.join(REPO, "kungfu_tpu", "base", "libkfnative.so")
+
+
+@pytest.fixture(scope="module", autouse=True)
+def build_native():
+    if not os.path.exists(LIB):
+        subprocess.run(["sh", os.path.join(REPO, "native", "build.sh")], check=True)
+
+
+def test_native_matches_numpy():
+    import ml_dtypes
+
+    from kungfu_tpu.base import _native_reduce as nr
+    from kungfu_tpu.base.ops import ReduceOp
+
+    rng = np.random.default_rng(0)
+    for dt in (np.float32, np.float64, np.float16, ml_dtypes.bfloat16,
+               np.int32, np.int64, np.uint8):
+        x = (rng.random(257) * 100).astype(dt)
+        y = (rng.random(257) * 100).astype(dt)
+        for op, ref in [
+            (ReduceOp.SUM, np.add),
+            (ReduceOp.MIN, np.minimum),
+            (ReduceOp.MAX, np.maximum),
+            (ReduceOp.PROD, np.multiply),
+        ]:
+            d = np.zeros(257, dtype=dt)
+            nr.transform2(d, x, y, int(op))
+            expect = ref(x, y)
+            if dt in (np.float16, ml_dtypes.bfloat16):
+                np.testing.assert_allclose(
+                    d.astype(np.float32), expect.astype(np.float32), rtol=2e-2
+                )
+            else:
+                np.testing.assert_array_equal(d, expect)
+
+
+def test_ops_dispatches_to_native():
+    from kungfu_tpu.base import ops
+
+    ops._native = None  # force re-probe
+    native = ops._load_native()
+    assert native, "native kernel should load after build"
+    x = np.ones(100, np.float32)
+    d = np.zeros(100, np.float32)
+    ops.transform2(d, x, x, ops.ReduceOp.SUM)
+    np.testing.assert_array_equal(d, np.full(100, 2.0))
+
+
+def test_stall_detector(capsys):
+    from kungfu_tpu.utils.stall import stall_detect
+
+    with stall_detect("test-op", period=0.1, force=True):
+        time.sleep(0.35)
+    err = capsys.readouterr().err
+    assert "test-op stalled" in err
+
+    # disabled by default: no output
+    with stall_detect("quiet-op", period=0.1):
+        time.sleep(0.15)
+    assert "quiet-op" not in capsys.readouterr().err
+
+
+def test_net_monitor_rates():
+    from kungfu_tpu.monitor.net import NetMonitor
+    from kungfu_tpu.plan.peer import PeerID
+
+    m = NetMonitor()
+    p = PeerID("10.0.0.1", 38000)
+    q = PeerID("10.0.0.2", 38000)
+    m.sent(p, 1000)
+    m.sent(p, 2000)
+    m.received(q, 500)
+    assert m.egress_totals()[p] == 3000
+    rates = m.egress_rates([p, q])
+    assert len(rates) == 2 and rates[1] == 0.0
+    text = m.render_metrics()
+    assert 'kungfu_egress_bytes{peer="10.0.0.1:38000"} 3000' in text
+    assert "kungfu_ingress_rate" in text
+
+
+def test_metrics_endpoint():
+    import urllib.request
+
+    from kungfu_tpu.monitor.net import MetricsServer, NetMonitor
+    from kungfu_tpu.plan.peer import PeerID
+
+    m = NetMonitor()
+    m.sent(PeerID("h", 1), 42)
+    srv = MetricsServer(m, 0)
+    srv.start()
+    try:
+        with urllib.request.urlopen(f"http://127.0.0.1:{srv.port}/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert 'kungfu_egress_bytes{peer="h:1"} 42' in body
+    finally:
+        srv.stop()
+
+
+def test_policy_runner():
+    from kungfu_tpu.policy import BasePolicy, PolicyRunner
+
+    events = []
+
+    class Recorder(BasePolicy):
+        def before_train(self, ctx):
+            events.append("bt")
+
+        def after_step(self, ctx):
+            events.append(("as", ctx.trained_samples))
+
+        def after_epoch(self, ctx):
+            events.append(("ae", ctx.epoch))
+
+        def after_train(self, ctx):
+            events.append("at")
+
+    with PolicyRunner([Recorder()], batch_size=32, total_samples=64) as r:
+        for _ in range(2):
+            with r.epoch():
+                for _ in range(2):
+                    with r.step():
+                        pass
+                    if r.ctx.stopped:
+                        break
+            if r.ctx.stopped:
+                break
+    assert events[0] == "bt" and events[-1] == "at"
+    assert ("as", 64) in events
+    assert r.ctx.stopped  # total_samples reached
