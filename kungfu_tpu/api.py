@@ -45,9 +45,11 @@ def current_cluster_version() -> int:
 
 
 def uid() -> int:
-    """(version, rank) packed; parity: python/__init__.py uid."""
+    """(version, rank) packed; parity: python/__init__.py uid. Rank gets the
+    low 32 bits so the version never collides with it (a 16-bit version
+    field would silently wrap after 65k resizes)."""
     p = get_default_peer()
-    return (p.cluster_version << 16) | p.rank
+    return (p.cluster_version << 32) | p.rank
 
 
 def detached() -> bool:
@@ -97,6 +99,16 @@ def propose_new_size(new_size: int) -> None:
 
 def change_cluster(progress: int):
     return get_default_peer().change_cluster(progress)
+
+
+def egress_rates() -> "np.ndarray":
+    """Per-peer egress rates (bytes/sec), rank-aligned (parity:
+    EgressRates op, ops/cpu/monitoring.cpp:5-22 + sess.GetEgressRates).
+    All zeros unless KF_CONFIG_ENABLE_MONITORING is set."""
+    from kungfu_tpu.monitor.net import get_monitor
+
+    sess = get_default_peer().current_session()
+    return np.asarray(get_monitor().egress_rates(list(sess.peers)), np.float64)
 
 
 def save(name: str, data: bytes) -> None:

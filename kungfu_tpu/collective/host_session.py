@@ -16,6 +16,7 @@ rchannel data plane.
 from __future__ import annotations
 
 import threading
+import time
 from typing import Callable, List, Optional, Sequence
 
 import numpy as np
@@ -29,15 +30,26 @@ from kungfu_tpu.plan.peer import PeerID, PeerList
 from kungfu_tpu.transport.client import Client
 from kungfu_tpu.transport.handlers import CollectiveEndpoint
 from kungfu_tpu.transport.message import ConnType, Flags
+from kungfu_tpu.utils.stall import stall_detect
 
 CHUNK_BYTES = 1 << 20  # 1 MiB, parity: session.go chunkSize
 DEFAULT_TIMEOUT = 120.0
 
 
-def _par(fns: List[Callable[[], None]], timeout: float) -> None:
+def _par(
+    fns: List[Callable[[], None]],
+    timeout: float,
+    cancel: Optional[threading.Event] = None,
+) -> None:
     """Run callables in parallel threads, join, re-raise the first error
     (goroutine-style fan-out; avoids pool-exhaustion deadlocks on nested
-    parallelism)."""
+    parallelism).
+
+    All joins share ONE deadline (worst case = timeout, not
+    len(fns)*timeout). On timeout `cancel` is set before raising so
+    abandoned daemon workers that later complete a recv can observe it and
+    must NOT mutate the caller's workspace (a reused recv buffer would be
+    corrupted by a late write)."""
     if not fns:
         return
     if len(fns) == 1:
@@ -56,9 +68,12 @@ def _par(fns: List[Callable[[], None]], timeout: float) -> None:
     threads = [threading.Thread(target=run, args=(fn,), daemon=True) for fn in fns]
     for t in threads:
         t.start()
+    deadline = time.monotonic() + timeout
     for t in threads:
-        t.join(timeout)
+        t.join(max(0.0, deadline - time.monotonic()))
         if t.is_alive():
+            if cancel is not None:
+                cancel.set()
             raise TimeoutError("collective thread timed out")
     if errs:
         raise errs[0]
@@ -108,11 +123,13 @@ class HostSession:
     # ------------------------------------------------------------------
 
     def all_reduce(self, w: Workspace) -> None:
-        self._run_strategies(w, self.global_strategies)
+        with stall_detect(f"all_reduce({w.name})"):
+            self._run_strategies(w, self.global_strategies)
 
     def cross_all_reduce(self, w: Workspace) -> None:
         """AllReduce across host masters only (hierarchical path)."""
-        self._run_strategies(w, self.cross_strategies)
+        with stall_detect(f"cross_all_reduce({w.name})"):
+            self._run_strategies(w, self.cross_strategies)
 
     def local_reduce(self, w: Workspace) -> None:
         self._run_graphs(w, [self.local_strategies[0].reduce_graph])
@@ -170,24 +187,68 @@ class HostSession:
         self.all_reduce(Workspace(x, out2, ReduceOp.MAX, f":consensus:max:{name}"))
         return bool(np.array_equal(out1, out2))
 
+    def broadcast_bytes(self, bs: bytes, name: str) -> bytes:
+        """Broadcast variable-length bytes from rank 0 (two graph walks:
+        length, then payload). Used to bootstrap the device plane — the
+        TPU analog of broadcasting the NCCL unique id over the CPU
+        collective (gpu_collective.cpp:190-212)."""
+        n_send = np.array([len(bs) if self.rank == 0 else 0], np.int64)
+        n_recv = np.zeros(1, np.int64)
+        self.broadcast(Workspace(n_send, n_recv, ReduceOp.SUM, f"{name}:len"))
+        n = int(n_recv[0])
+        if n == 0:
+            return b""
+        if self.rank == 0:
+            send = np.frombuffer(bs, np.uint8)
+        else:
+            send = np.zeros(n, np.uint8)
+        recv = np.zeros(n, np.uint8)
+        self.broadcast(Workspace(send, recv, ReduceOp.SUM, f"{name}:data"))
+        return recv.tobytes()
+
     def gather(self, w: Workspace) -> None:
         """Rank 0 receives everyone's send buffer into recv (rank-major);
-        parity: runGather (session.go:195-221)."""
+        parity: runGather (session.go:195-221). Handles unequal per-peer
+        counts: the wire framing carries each message's true length, so the
+        root lays contributions out by their actual sizes (the reference
+        relies on the same message framing)."""
         root = 0
-        count = w.send.size
         if self.rank != root:
             self.client.send(
                 self.peers[root], w.name, w.send.tobytes(), ConnType.COLLECTIVE
             )
             return
+        cancel = threading.Event()
+        parts: List[Optional[np.ndarray]] = [None] * len(self.peers)
+
+        def recv_part(r: int, peer: PeerID) -> None:
+            msg = self.endpoint.recv(peer, w.name, self.timeout)
+            if cancel.is_set():
+                return
+            parts[r] = np.frombuffer(msg.data, w.send.dtype)
+
         jobs = []
         for r, peer in enumerate(self.peers):
-            dst = w.recv[r * count:(r + 1) * count]
             if r == self.rank:
-                np.copyto(dst, w.send)
+                parts[r] = w.send.reshape(-1)
             else:
-                jobs.append(lambda p=peer, d=dst: self._recv_into(p, w.name, d))
-        _par(jobs, self.timeout)
+                jobs.append(lambda r=r, p=peer: recv_part(r, p))
+        _par(jobs, self.timeout, cancel)
+        off = 0
+        for part in parts:
+            assert part is not None
+            n = part.size
+            if off + n > w.recv.size:
+                raise ValueError(
+                    f"gather overflow: recv buffer {w.recv.size} < {off + n}"
+                )
+            np.copyto(w.recv[off:off + n], part)
+            off += n
+        if off != w.recv.size:
+            # a short contribution would silently shift later ranks' data
+            raise ValueError(
+                f"gather underflow: contributions fill {off} of {w.recv.size}"
+            )
 
     def all_gather(self, w: Workspace) -> None:
         """Gather to root then broadcast the concatenation (parity:
@@ -200,36 +261,43 @@ class HostSession:
     # engine
     # ------------------------------------------------------------------
 
-    def _recv_into(self, peer: PeerID, name: str, dst: np.ndarray) -> None:
-        msg = self.endpoint.recv(peer, name, self.timeout)
-        src = np.frombuffer(msg.data, dst.dtype)
-        np.copyto(dst, src)
-
     def _run_strategies(self, w: Workspace, strategies: List[st.StrategyPair]) -> None:
         total = w.recv.size * w.recv.itemsize
         k = max(1, -(-total // CHUNK_BYTES))
         chunks = w.split(even_partition, k) if k > 1 else [w]
+        cancel = threading.Event()
         if k == 1:
             pair = strategies[0]
-            self._run_graphs(chunks[0], [pair.reduce_graph, pair.bcast_graph])
+            self._run_graphs(chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel)
             return
         jobs = []
         for i, chunk in enumerate(chunks):
             pair = st.choose(strategies, i)
             jobs.append(
                 lambda c=chunk, p=pair: self._run_graphs(
-                    c, [p.reduce_graph, p.bcast_graph]
+                    c, [p.reduce_graph, p.bcast_graph], cancel
                 )
             )
-        _par(jobs, self.timeout)
+        _par(jobs, self.timeout, cancel)
 
-    def _run_graphs(self, w: Workspace, graphs: List[Graph]) -> None:
-        """The hot walk; parity: runGraphs (session.go:231-299)."""
+    def _run_graphs(
+        self,
+        w: Workspace,
+        graphs: List[Graph],
+        cancel: Optional[threading.Event] = None,
+    ) -> None:
+        """The hot walk; parity: runGraphs (session.go:231-299).
+
+        `cancel` is shared across every thread touching this workspace: once
+        any part of the collective times out, late-arriving receives must not
+        write into (possibly reused) caller buffers."""
         if w.is_empty:
             return
         if all(g.is_isolated(self.rank) for g in graphs):
             w.forward()
             return
+        if cancel is None:
+            cancel = threading.Event()
 
         state = {"recv_count": 0}
         lock = threading.Lock()
@@ -248,6 +316,10 @@ class HostSession:
             msg = self.endpoint.recv(peer, w.name, self.timeout)
             incoming = np.frombuffer(msg.data, w.send.dtype)
             with lock:
+                if cancel.is_set():
+                    # abort the whole walk: a late arrival must neither write
+                    # the workspace nor let the send phase relay stale data
+                    raise TimeoutError(f"collective cancelled: {w.name}")
                 if state["recv_count"] == 0 and not w.is_inplace:
                     # first arrival: recv = send (op) incoming
                     from kungfu_tpu.base.ops import transform2
@@ -258,8 +330,12 @@ class HostSession:
                 state["recv_count"] += 1
 
         def recv_into(peer: PeerID) -> None:
-            self._recv_into(peer, w.name, w.recv)
+            msg = self.endpoint.recv(peer, w.name, self.timeout)
             with lock:
+                if cancel.is_set():
+                    raise TimeoutError(f"collective cancelled: {w.name}")
+                src = np.frombuffer(msg.data, w.recv.dtype)
+                np.copyto(w.recv, src)
                 state["recv_count"] += 1
 
         for g in graphs:
@@ -267,8 +343,8 @@ class HostSession:
             nexts = [self.peers[r] for r in g.nexts(self.rank)]
             if g.is_self_loop(self.rank):
                 # accumulate: receive from all prevs (parallel), then send on
-                _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout)
-                _par([lambda p=p: send_to(p) for p in nexts], self.timeout)
+                _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout, cancel)
+                _par([lambda p=p: send_to(p) for p in nexts], self.timeout, cancel)
             else:
                 # pass-through node: take value from single prev (or forward
                 # own), relay to nexts
@@ -280,4 +356,5 @@ class HostSession:
                 _par(
                     [lambda p=p: send_to(p, Flags.WAIT_RECV_BUF) for p in nexts],
                     self.timeout,
+                    cancel,
                 )

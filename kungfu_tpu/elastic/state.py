@@ -5,7 +5,8 @@ Capability parity: srcs/python/kungfu/python/elastic_state.py:4-79 —
   while not es.stopped():
       with es.scope():          # begin(): sync progress after resize
           train_one_batch()
-          es.advance(batch_size)  # end(): progress += n, maybe resize
+          es.end(batch_size)    # progress += n, maybe resize
+                                # (es.advance is an alias for es.end)
 Stop reasons: 'finished' | 'detached' | 'reload'.
 """
 
@@ -49,6 +50,8 @@ class ElasticState:
             self._stop_reason = "detached"
         elif changed:
             self._synced = False
+
+    advance = end  # documented alias
 
     @contextlib.contextmanager
     def scope(self):

@@ -108,9 +108,11 @@ def adaptive_sgd(
                 # switch step: fold in the rank-0 re-sync broadcast
                 from kungfu_tpu.ops.collective import broadcast
 
-                at_switch = (state.step == change_step).astype(jnp.float32)
+                at_switch = state.step == change_step
                 u = jax.tree.map(
-                    lambda ui, p: ui + at_switch * (broadcast(p, axis_name) - p),
+                    lambda ui, p: ui
+                    + at_switch.astype(ui.dtype)
+                    * (broadcast(p, axis_name) - p).astype(ui.dtype),
                     u,
                     params,
                 )

@@ -1,4 +1,18 @@
 from kungfu_tpu.parallel.mesh import DeviceSession, make_mesh
 from kungfu_tpu.parallel.dp import make_train_step
+from kungfu_tpu.parallel.distributed import (
+    device_plane_initialized,
+    initialize_device_plane,
+    reinitialize_device_plane,
+    shutdown_device_plane,
+)
 
-__all__ = ["DeviceSession", "make_mesh", "make_train_step"]
+__all__ = [
+    "DeviceSession",
+    "make_mesh",
+    "make_train_step",
+    "initialize_device_plane",
+    "reinitialize_device_plane",
+    "shutdown_device_plane",
+    "device_plane_initialized",
+]

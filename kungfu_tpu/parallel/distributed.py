@@ -1,0 +1,135 @@
+"""Multi-host device-plane bootstrap over the host control plane.
+
+The reference bootstraps its GPU data plane by having rank 0 create an
+NCCL unique id and broadcasting it over the CPU collective
+(srcs/cpp/src/nccl/gpu_collective.cpp:190-243). The TPU-native analog:
+rank 0 picks a JAX coordination-service address, broadcasts it over the
+HOST plane (kfrun's TCP collectives), and every worker calls
+`jax.distributed.initialize` with its host-plane rank — after which
+`jax.devices()` spans ALL workers' chips and one `jax.sharding.Mesh` /
+compiled program covers the whole cluster (SURVEY §7 stages 4+6).
+
+Elastic semantics:
+- reload mode (PRIMARY on TPU — the ICI mesh shape is fixed per slice):
+  workers exit on resize, runners respawn them, and the fresh processes
+  bootstrap a fresh device plane here. Nothing to tear down.
+- delta mode: `reinitialize_device_plane()` tears the XLA backend down
+  in-process (distributed shutdown + backend clear) and bootstraps again
+  over the NEW host session. Works on CPU clusters; on real TPU pods
+  prefer reload mode — the TPU runtime does not always release chips
+  cleanly for in-process re-init.
+"""
+
+from __future__ import annotations
+
+import socket
+import threading
+from typing import Optional
+
+from kungfu_tpu.utils import log
+from kungfu_tpu.utils.stall import stall_detect
+
+_state = {"initialized": False, "local_only": False, "version": -1}
+_lock = threading.Lock()
+
+
+def _free_port(host: str) -> int:
+    s = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
+    try:
+        s.bind((host if host not in ("localhost",) else "127.0.0.1", 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def device_plane_initialized() -> bool:
+    return _state["initialized"]
+
+
+def initialize_device_plane(platform: Optional[str] = None) -> None:
+    """Stand up ONE JAX world across all workers of the current cluster.
+
+    Must run before any other JAX API touches the backend (jax.devices()
+    etc.) — the same constraint the reference's NCCL init has. Single
+    process (no kfrun): no-op, local devices only.
+    """
+    import jax
+
+    from kungfu_tpu.peer import get_default_peer
+
+    with _lock:
+        if _state["initialized"]:
+            return
+        peer = get_default_peer()
+        if platform:
+            jax.config.update("jax_platforms", platform)
+        sess = peer.current_session()
+        if peer.config.single_process or sess.size == 1:
+            _state["local_only"] = True
+            _state["initialized"] = True
+            log.debug("device plane: single-process, local devices only")
+            return
+        if sess.rank == 0:
+            host = peer.self_id.host
+            addr = f"{host}:{_free_port(host)}".encode()
+        else:
+            addr = b""
+        with stall_detect("device_plane_bootstrap"):
+            addr = sess.broadcast_bytes(addr, f"kungfu::devplane:v{peer.cluster_version}")
+            coordinator = addr.decode()
+            log.info(
+                "device plane: initializing process %d/%d, coordinator %s",
+                sess.rank, sess.size, coordinator,
+            )
+            jax.distributed.initialize(
+                coordinator_address=coordinator,
+                num_processes=sess.size,
+                process_id=sess.rank,
+            )
+        _state["initialized"] = True
+        _state["local_only"] = False
+        _state["version"] = peer.cluster_version
+
+
+def shutdown_device_plane() -> None:
+    """Tear down the distributed JAX backend so a new world can form."""
+    import jax
+
+    with _lock:
+        if not _state["initialized"]:
+            return
+        if not _state["local_only"]:
+            jax.distributed.shutdown()
+        # Drop live backends + compiled programs so the next JAX call (after
+        # re-initialize) builds a client for the NEW process set. JAX has no
+        # public backend-reset API; feature-detect the internal one and fail
+        # with guidance (use reload mode) if a future JAX moves it.
+        try:
+            from jax._src import xla_bridge
+
+            xla_bridge._clear_backends()
+        except (ImportError, AttributeError) as e:
+            _state["initialized"] = False
+            _state["local_only"] = False
+            raise RuntimeError(
+                "cannot reset the XLA backend in-process with this JAX "
+                "version; use elastic reload mode (process restart) instead"
+            ) from e
+        jax.clear_caches()
+        _state["initialized"] = False
+        _state["local_only"] = False
+
+
+def reinitialize_device_plane(platform: Optional[str] = None) -> None:
+    """Delta-mode elastic rebuild: new host session -> new JAX world.
+
+    The caller must drop references to arrays/compiled functions from the
+    old world first (they hold the old backend alive). Parity: NCCL
+    ReInit per new cluster version (nccl/controller.hpp:14-44).
+    """
+    shutdown_device_plane()
+    initialize_device_plane(platform)
+
+
+def current_device_plane_version() -> int:
+    return _state["version"]

@@ -35,6 +35,8 @@ from kungfu_tpu.transport.handlers import (
 )
 from kungfu_tpu.transport.message import ConnType, Flags, Message
 from kungfu_tpu.transport.server import Server
+from kungfu_tpu.utils import log
+from kungfu_tpu.utils.stall import stall_detect
 
 _default_peer: Optional["Peer"] = None
 _default_lock = threading.Lock()
@@ -83,11 +85,29 @@ class Peer:
     def start(self) -> None:
         if not self.config.single_process:
             self.server.start()
+        self._start_metrics_server()
         self._update_to(self._peers)
+
+    def _start_metrics_server(self) -> None:
+        """Expose /metrics on self.port+10000 when monitoring is on
+        (parity: peer/peer.go:96-104)."""
+        self.metrics_server = None
+        from kungfu_tpu.monitor import net as _net
+
+        if _net.enabled() and not self.config.single_process:
+            try:
+                self.metrics_server = _net.MetricsServer(
+                    _net.get_monitor(), self.self_id.port + 10000
+                )
+                self.metrics_server.start()
+            except OSError as e:
+                log.warn("metrics server failed to start: %s", e)
 
     def stop(self) -> None:
         self.server.stop()
         self.client.close()
+        if getattr(self, "metrics_server", None) is not None:
+            self.metrics_server.stop()
 
     # ------------------------------------------------------------------
     @property
@@ -172,16 +192,18 @@ class Peer:
 
     def _wait_new_config(self, url: str) -> Cluster:
         """Poll the config server until all current peers see the same
-        cluster (parity: waitNewConfig, peer.go:242-263)."""
+        cluster (parity: waitNewConfig, peer.go:242-263). When the server is
+        unreachable or has no config, each peer falls back to its CURRENT
+        cluster (the reference's "using current config" path) — once all
+        peers agree (e.g. the server is down for everyone) the resize
+        degrades to a no-op instead of hanging the training loop."""
         sess = self.current_session()
+        current = Cluster(runners=self.config.runners, workers=self._peers)
         while True:
-            cluster = self._get_config(url)
-            if cluster is not None:
+            cluster = self._get_config(url) or current
+            with stall_detect(f"wait_new_config({url})"):
                 if sess.bytes_consensus(cluster.to_bytes(), ":cfg"):
                     return cluster
-            else:
-                # still consense on "no config" so peers stay in lockstep
-                sess.bytes_consensus(b"", ":cfg")
             time.sleep(0.2)
 
     def resize_cluster_from_url(self) -> Tuple[bool, bool]:

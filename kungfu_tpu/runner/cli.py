@@ -150,6 +150,7 @@ def make_one_worker_proc(
         elastic_mode=args.elastic_mode,
         init_progress=progress,
     )
+    env["KF_LOG_PREFIX"] = f"{rank}/{len(cluster.workers)}"
     return WorkerProc(
         name=f"{rank}/{len(cluster.workers)}",
         argv=list(cmd),

@@ -36,6 +36,11 @@ class Client:
         self._locks: Dict[Tuple[PeerID, ConnType], threading.Lock] = {}
         self._pool_lock = threading.Lock()
         self._use_unix = use_unix
+        # egress accounting (parity: monitor.Egress called from the
+        # connection send path, srcs/go/monitor/monitor.go:28-72)
+        from kungfu_tpu.monitor import net as _net
+
+        self._monitor = _net.get_monitor() if _net.enabled() else None
 
     def set_token(self, token: int) -> None:
         self._token = token
@@ -114,6 +119,8 @@ class Client:
                 with self._pool_lock:
                     self._pool[key] = sock
                 send_message(sock, Message(name=name, data=data, flags=flags))
+        if self._monitor is not None:
+            self._monitor.sent(peer, len(data))
 
     def ping(self, peer: PeerID, timeout: float = 2.0) -> bool:
         try:

@@ -121,8 +121,13 @@ class Server:
             if handler is None:
                 conn.close()
                 return
+            from kungfu_tpu.monitor import net as _net
+
+            monitor = _net.get_monitor() if _net.enabled() else None
             while not self._stopped.is_set():
                 msg = recv_message(conn)
+                if monitor is not None:
+                    monitor.received(src, len(msg.data))
                 handler(src, msg)
         except (ConnectionError, OSError):
             pass

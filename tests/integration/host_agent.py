@@ -5,7 +5,9 @@ localhost multi-process cluster across the strategy x np matrix
 (scripts/tests/run-integration-tests.sh:30-38).
 """
 
+import os
 import sys
+import urllib.request
 
 import numpy as np
 
@@ -51,6 +53,26 @@ def main() -> int:
     assert api.request(other, "no-such-blob") is None
 
     api.run_barrier()
+
+    # monitoring e2e (parity: kungfu-test-monitor, ci.yaml:36-41): with
+    # KF_CONFIG_ENABLE_MONITORING the transport must have counted real bytes
+    # and the /metrics endpoint must serve them.
+    if os.environ.get("KF_CONFIG_ENABLE_MONITORING") in ("1", "true") and size > 1:
+        from kungfu_tpu.monitor.net import get_monitor
+        from kungfu_tpu.peer import get_default_peer
+
+        totals = get_monitor().egress_totals()
+        assert sum(totals.values()) > 0, f"no egress counted: {totals}"
+        rates = api.egress_rates()
+        assert rates.shape == (size,)
+        me = get_default_peer().self_id
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{me.port + 10000}/metrics", timeout=5
+        ) as resp:
+            body = resp.read().decode()
+        assert "kungfu_egress_bytes" in body, body[:200]
+        api.run_barrier()  # keep servers alive until everyone checked
+
     print(f"OK rank={rank}/{size}")
     return 0
 

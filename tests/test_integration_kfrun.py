@@ -52,6 +52,13 @@ def test_kfrun_all_strategies_np4(strategy):
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
 
 
+def test_kfrun_monitoring_counts_bytes():
+    """Parity: monitoring CI test (ci.yaml:36-41) — egress counters must be
+    nonzero after real collectives and /metrics must serve them."""
+    r = run_kfrun(2, "AUTO", extra_env={"KF_CONFIG_ENABLE_MONITORING": "1"})
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
 def test_kfrun_propagates_worker_failure():
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
