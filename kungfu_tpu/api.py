@@ -101,6 +101,96 @@ def change_cluster(progress: int):
     return get_default_peer().change_cluster(progress)
 
 
+def monitored_all_reduce_array(
+    x: np.ndarray, op: ReduceOp = ReduceOp.SUM, name: str = "user"
+) -> np.ndarray:
+    """Host-plane allreduce with throughput accounting feeding the adaptive
+    controller (parity: MonitoredAllReduce op)."""
+    flat = np.ascontiguousarray(x).reshape(-1)
+    out = np.zeros_like(flat)
+    w = Workspace(send=flat, recv=out, op=op, name=f"kungfu::monitored::{name}")
+    get_default_peer().current_session().monitored_all_reduce(w)
+    return out.reshape(x.shape)
+
+
+def check_interference() -> bool:
+    """Vote on interference; True if the cluster switched strategy (parity:
+    check_interference, session/adaptiveStrategies.go:61-121)."""
+    return get_default_peer().current_session().check_interference()
+
+
+def active_strategy() -> str:
+    """Name of the running strategy; "SET_TREE" under a set_tree override."""
+    s = get_default_peer().current_session().active_strategy()
+    return s.name if s is not None else "SET_TREE"
+
+
+def calc_stats() -> dict:
+    """Per-strategy throughput stats (parity: calc_stats/log_stats ops)."""
+    return get_default_peer().current_session().calc_stats()
+
+
+def get_peer_latencies(samples: int = 3) -> np.ndarray:
+    """RTT seconds to every peer (self = 0); parity: GetPeerLatencies op."""
+    from kungfu_tpu.monitor.latency import probe_peer_latencies
+
+    p = get_default_peer()
+    sess = p.current_session()
+    return probe_peer_latencies(p.client, list(sess.peers), sess.rank, samples)
+
+
+def minimum_spanning_tree(weights) -> list:
+    """Father array of the MST of a dense cost matrix (parity:
+    MinimumSpanningTree op backed by the native Prim kernel)."""
+    from kungfu_tpu.plan.mst import minimum_spanning_tree as _mst
+
+    return _mst(weights)
+
+
+def optimized_tree(samples: int = 3) -> list:
+    """Probe latencies, allgather rows into the full matrix, and return the
+    MST father array — identical on every peer (deterministic MST over the
+    consensus matrix), ready for set_tree."""
+    from kungfu_tpu.monitor.latency import latency_matrix_from_rows
+
+    sess = get_default_peer().current_session()
+    n = sess.size
+    row = get_peer_latencies(samples)
+    recv = np.zeros(n * n, np.float64)
+    w = Workspace(send=row, recv=recv, op=ReduceOp.SUM, name="kungfu::latency")
+    sess.all_gather(w)
+    matrix = latency_matrix_from_rows(list(recv.reshape(n, n)))
+    return minimum_spanning_tree(matrix)
+
+
+def set_tree(fathers) -> None:
+    """Install + persist a collective forest (parity: SetTree op)."""
+    get_default_peer().set_tree(fathers)
+
+
+def get_neighbour(step: int) -> int:
+    """Deterministic partner schedule: at step t, pair with the peer whose
+    rank differs in bit position (t mod log2-ceiling) — a hypercube-style
+    schedule giving each peer a distinct partner per step (capability
+    parity: GetNeighbour op for PairAveraging peer selection)."""
+    sess = get_default_peer().current_session()
+    n, r = sess.size, sess.rank
+    if n == 1:
+        return 0
+    bits = max(1, (n - 1).bit_length())
+    partner = r ^ (1 << (step % bits))
+    return partner if partner < n else r
+
+
+def round_robin_peer(step: int) -> int:
+    """Round-robin over the other peers (parity: RoundRobin op)."""
+    sess = get_default_peer().current_session()
+    n, r = sess.size, sess.rank
+    if n == 1:
+        return 0
+    return (r + 1 + step % (n - 1)) % n
+
+
 def egress_rates() -> "np.ndarray":
     """Per-peer egress rates (bytes/sec), rank-aligned (parity:
     EgressRates op, ops/cpu/monitoring.cpp:5-22 + sess.GetEgressRates).

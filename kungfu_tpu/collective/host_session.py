@@ -23,6 +23,7 @@ import numpy as np
 
 from kungfu_tpu.base.ops import ReduceOp, reduce_inplace
 from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.collective.adaptive import AdaptiveState
 from kungfu_tpu.base.workspace import Workspace, even_partition
 from kungfu_tpu.collective import strategies as st
 from kungfu_tpu.plan.graph import Graph
@@ -110,6 +111,27 @@ class HostSession:
         self.global_strategies = st.gen_global_strategies(peers, strategy)
         self.local_strategies = st.gen_local_strategies(peers)
         self.cross_strategies = st.gen_cross_strategies(peers, strategy)
+        # adaptive control (parity: session/adaptiveStrategies.go): a
+        # deterministic candidate order — identical on every peer — so a
+        # majority vote can advance everyone in lockstep. Candidate graph
+        # lists are built lazily: sessions are rebuilt every elastic epoch
+        # and most never adapt.
+        self._candidate_names = [strategy] + [
+            s for s in (
+                Strategy.RING, Strategy.BINARY_TREE_STAR, Strategy.STAR,
+                Strategy.CLIQUE,
+            ) if s != strategy
+        ]
+        self._candidates_built: dict = {0: self.global_strategies}
+        self.adaptive = AdaptiveState(len(self._candidate_names))
+        self._tree_override = False
+
+    def _candidate(self, idx: int) -> List[st.StrategyPair]:
+        if idx not in self._candidates_built:
+            self._candidates_built[idx] = st.gen_global_strategies(
+                self.peers, self._candidate_names[idx]
+            )
+        return self._candidates_built[idx]
 
     @property
     def size(self) -> int:
@@ -125,6 +147,63 @@ class HostSession:
     def all_reduce(self, w: Workspace) -> None:
         with stall_detect(f"all_reduce({w.name})"):
             self._run_strategies(w, self.global_strategies)
+
+    def monitored_all_reduce(self, w: Workspace) -> None:
+        """AllReduce + throughput accounting for the ACTIVE strategy
+        (parity: KungfuMonitoredAllReduce, ops/cpu/collective.cpp:149-196 +
+        runMonitoredStrategies, session/monitoring.go:15-35)."""
+        nbytes = w.recv.size * w.recv.itemsize
+        t0 = time.perf_counter()
+        with stall_detect(f"monitored_all_reduce({w.name})"):
+            self._run_strategies(w, self.global_strategies)
+        self.adaptive.current.update(nbytes, time.perf_counter() - t0)
+
+    def check_interference(self, vote_tag: str = "") -> bool:
+        """Majority vote on local interference suspicion; on a cluster-wide
+        majority every peer advances to the next candidate strategy in the
+        same deterministic order. Returns True if the strategy switched.
+        Parity: CheckInterference + MonitoredAllReduce consensus switch
+        (session/adaptiveStrategies.go:61-121)."""
+        if self._tree_override or len(self._candidate_names) < 2:
+            return False
+        suspect = self.adaptive.current.suspect_interference()
+        votes_in = np.array([1 if suspect else 0], np.int32)
+        votes_out = np.zeros(1, np.int32)
+        self.all_reduce(
+            Workspace(votes_in, votes_out, ReduceOp.SUM,
+                      f"kungfu::interference:{self.adaptive.switch_count}{vote_tag}")
+        )
+        if int(votes_out[0]) * 2 <= self.size:
+            return False
+        idx = self.adaptive.advance()
+        self.global_strategies = self._candidate(idx)
+        # safety: all peers must now run the same graphs
+        if not self.bytes_consensus(
+            st.digest(self.global_strategies), f":switch:{self.adaptive.switch_count}"
+        ):
+            raise RuntimeError("strategy switch diverged across peers")
+        return True
+
+    def active_strategy(self) -> Optional[Strategy]:
+        """The running candidate strategy, or None when an explicit
+        set_tree forest overrides the candidates."""
+        if self._tree_override:
+            return None
+        return self._candidate_names[self.adaptive.active]
+
+    def set_tree(self, fathers: Sequence[int]) -> None:
+        """Install a runtime forest (e.g. an MST over probed latencies) as
+        the active global strategy (parity: SetTree / SetGlobalStrategy,
+        adaptation.cpp:5-33). Disables vote-driven switching — an explicit
+        tree wins until the next session epoch."""
+        if len(fathers) != self.size:
+            raise ValueError(f"forest size {len(fathers)} != cluster {self.size}")
+        self.global_strategies = st.from_forest_array(list(fathers))
+        self._tree_override = True
+
+    def calc_stats(self) -> dict:
+        """Per-strategy throughput summary (parity: CalcStats/LogStats)."""
+        return self.adaptive.summary()
 
     def cross_all_reduce(self, w: Workspace) -> None:
         """AllReduce across host masters only (hierarchical path)."""

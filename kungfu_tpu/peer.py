@@ -70,6 +70,7 @@ class Peer:
         self._session: Optional[HostSession] = None
         self._session_lock = threading.RLock()
         self._updated = True
+        self._persisted_tree: Optional[list] = None
 
         self.store = BlobStore()
         self.client = Client(self.self_id, use_unix=not config.single_process)
@@ -100,7 +101,8 @@ class Peer:
                     _net.get_monitor(), self.self_id.port + 10000
                 )
                 self.metrics_server.start()
-            except OSError as e:
+            except (OSError, OverflowError) as e:
+                # OverflowError: peer port within 10000 of 65535
                 log.warn("metrics server failed to start: %s", e)
 
     def stop(self) -> None:
@@ -141,11 +143,25 @@ class Peer:
                 self.client,
                 self.collective,
             )
+            # persisted set_tree (parity: SetTree, adaptation.cpp:5-33):
+            # reapply across epochs while the rank space is unchanged; a
+            # resize invalidates the father array, so it is dropped then.
+            if self._persisted_tree is not None:
+                if len(self._persisted_tree) == len(peers):
+                    self._session.set_tree(self._persisted_tree)
+                else:
+                    self._persisted_tree = None
             self._peers = peers
         if not self.config.single_process:
             self._session.barrier(tag=f":v{self.cluster_version}")
         self._updated = True
         return True
+
+    def set_tree(self, fathers) -> None:
+        """Install + persist a runtime collective forest."""
+        fathers = list(int(f) for f in fathers)
+        self.current_session().set_tree(fathers)
+        self._persisted_tree = fathers
 
     # ------------------------------------------------------------------
     # elastic resize protocol (parity: peer.go propose/ResizeCluster*)
@@ -183,12 +199,18 @@ class Peer:
         keep = self._update_to(cluster.workers)
         return True, keep
 
-    def _get_config(self, url: str) -> Optional[Cluster]:
-        try:
-            with urllib.request.urlopen(url, timeout=5) as resp:
-                return Cluster.loads(resp.read().decode())
-        except Exception:
-            return None
+    def _get_config(self, url: str, attempts: int = 3) -> Optional[Cluster]:
+        """GET the desired cluster; a few retries absorb transient server
+        blips so a published resize isn't silently dropped by the
+        current-cluster fallback in _wait_new_config."""
+        for i in range(attempts):
+            try:
+                with urllib.request.urlopen(url, timeout=5) as resp:
+                    return Cluster.loads(resp.read().decode())
+            except Exception:
+                if i + 1 < attempts:
+                    time.sleep(0.3)
+        return None
 
     def _wait_new_config(self, url: str) -> Cluster:
         """Poll the config server until all current peers see the same

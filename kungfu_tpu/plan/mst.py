@@ -1,0 +1,80 @@
+"""Minimum spanning tree over a peer latency matrix.
+
+Capability parity: the reference's MST topology optimization
+(srcs/cpp/include/kungfu/mst.hpp:9-59, exposed as the MinimumSpanningTree
+TF op, ops/cpu/topology.cpp:84-196). The control plane probes per-peer
+RTTs, allgathers them into a dense matrix, and the MST over that matrix
+becomes the reduce/broadcast forest for HOST-plane (DCN) collectives.
+
+Native Prim's kernel in native/mst.cpp (ctypes), numpy fallback here.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+from typing import List, Sequence
+
+import numpy as np
+
+_kf_mst = None
+_LIB_PATH = os.path.join(os.path.dirname(__file__), "..", "base", "libkfnative.so")
+try:
+    _lib = ctypes.CDLL(os.path.abspath(_LIB_PATH))
+    _fn = getattr(_lib, "kf_mst", None)
+    if _fn is not None:
+        _fn.restype = ctypes.c_int
+        _fn.argtypes = [ctypes.c_int64, ctypes.c_void_p, ctypes.c_void_p]
+        _kf_mst = _fn
+except OSError:
+    pass
+
+
+def _mst_numpy(w: np.ndarray) -> np.ndarray:
+    """Prim's, O(n^2); father[0] == 0 (root)."""
+    n = w.shape[0]
+    father = np.zeros(n, np.int32)
+    done = np.zeros(n, bool)
+    done[0] = True
+    best_cost = w[0].copy()
+    best_from = np.zeros(n, np.int64)
+    best_cost[0] = np.inf
+    for _ in range(n - 1):
+        masked = np.where(done, np.inf, best_cost)
+        pick = int(np.argmin(masked))
+        if not np.isfinite(masked[pick]):
+            raise ValueError("disconnected latency graph")
+        done[pick] = True
+        father[pick] = best_from[pick]
+        better = (~done) & (w[pick] < best_cost)
+        best_cost[better] = w[pick][better]
+        best_from[better] = pick
+    return father
+
+
+def minimum_spanning_tree(weights: Sequence[Sequence[float]]) -> List[int]:
+    """Father array of the MST of a dense symmetric cost matrix."""
+    w = np.ascontiguousarray(weights, np.float64)
+    if w.ndim != 2 or w.shape[0] != w.shape[1]:
+        raise ValueError(f"weights must be square, got {w.shape}")
+    n = w.shape[0]
+    if n == 0:
+        return []
+    if n == 1:
+        return [0]
+    if _kf_mst is not None:
+        father = np.zeros(n, np.int32)
+        rc = _kf_mst(
+            n,
+            w.ctypes.data_as(ctypes.c_void_p),
+            father.ctypes.data_as(ctypes.c_void_p),
+        )
+        if rc == 0:
+            return father.tolist()
+        if rc == 2:
+            raise ValueError("disconnected latency graph")
+    return _mst_numpy(w).tolist()
+
+
+def uses_native() -> bool:
+    return _kf_mst is not None

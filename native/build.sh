@@ -5,5 +5,5 @@ set -e
 cd "$(dirname "$0")"
 CXX=${1:-g++}
 OUT=../kungfu_tpu/base/libkfnative.so
-$CXX -O3 -march=native -shared -fPIC -std=c++17 -o "$OUT" reduce.cpp
+$CXX -O3 -march=native -shared -fPIC -std=c++17 -o "$OUT" reduce.cpp mst.cpp
 echo "built $OUT"

@@ -1,0 +1,141 @@
+"""Unit tests for the adaptive subsystem: MST, throughput stats, vote state.
+
+Parity model: reference MST (mst.hpp) + adaptation stats
+(session/monitoring.go, adaptiveStrategies.go).
+"""
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.collective.adaptive import (
+    INTERFERENCE_THRESHOLD,
+    WARMUP_SAMPLES,
+    AdaptiveState,
+    StrategyStat,
+)
+from kungfu_tpu.plan.graph import Graph
+from kungfu_tpu.plan.mst import _mst_numpy, minimum_spanning_tree, uses_native
+
+
+def _tree_weight(fathers, w):
+    return sum(w[i][fathers[i]] for i in range(1, len(fathers)))
+
+
+def _kruskal_weight(w):
+    """Independent MST weight via Kruskal for cross-checking."""
+    n = w.shape[0]
+    edges = sorted(
+        (w[i][j], i, j) for i in range(n) for j in range(i + 1, n)
+    )
+    parent = list(range(n))
+
+    def find(x):
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    total, used = 0.0, 0
+    for c, i, j in edges:
+        ri, rj = find(i), find(j)
+        if ri != rj:
+            parent[ri] = rj
+            total += c
+            used += 1
+            if used == n - 1:
+                break
+    return total
+
+
+class TestMST:
+    def test_trivial(self):
+        assert minimum_spanning_tree([[0.0]]) == [0]
+        assert minimum_spanning_tree(np.zeros((0, 0))) == []
+
+    def test_line_graph(self):
+        # chain costs: 0-1 cheap, 1-2 cheap, 0-2 expensive
+        w = [[0, 1, 10], [1, 0, 1], [10, 1, 0]]
+        assert minimum_spanning_tree(w) == [0, 0, 1]
+
+    def test_valid_forest_and_optimal_weight(self):
+        rng = np.random.RandomState(7)
+        for n in (2, 3, 5, 8, 13):
+            a = rng.rand(n, n) * 10
+            w = (a + a.T) / 2
+            np.fill_diagonal(w, 0)
+            fathers = minimum_spanning_tree(w)
+            # father array must form a connected tree rooted at 0
+            g, roots, ok = Graph.from_forest_array(fathers)
+            assert ok and roots == 1, fathers
+            assert fathers[0] == 0
+            # optimal total weight (cross-check vs independent Kruskal)
+            assert _tree_weight(fathers, w) == pytest.approx(_kruskal_weight(w))
+
+    def test_native_matches_numpy(self):
+        if not uses_native():
+            pytest.skip("native kernel not built")
+        rng = np.random.RandomState(3)
+        for n in (2, 6, 17):
+            a = rng.rand(n, n)
+            w = (a + a.T) / 2
+            np.fill_diagonal(w, 0)
+            assert minimum_spanning_tree(w) == _mst_numpy(w).tolist()
+
+    def test_disconnected_graph_raises(self):
+        w = np.array([[0, 1, np.inf], [1, 0, np.inf], [np.inf, np.inf, 0]])
+        with pytest.raises(ValueError, match="disconnected"):
+            minimum_spanning_tree(w)  # native and fallback must both raise
+        with pytest.raises(ValueError, match="disconnected"):
+            _mst_numpy(w)
+
+    def test_rejects_bad_shapes(self):
+        with pytest.raises(ValueError):
+            minimum_spanning_tree(np.zeros((2, 3)))
+        with pytest.raises(ValueError):
+            minimum_spanning_tree(np.zeros(4))
+
+
+class TestStrategyStat:
+    def test_no_suspicion_during_warmup(self):
+        s = StrategyStat()
+        for _ in range(WARMUP_SAMPLES - 1):
+            s.update(1000, 1.0)
+        assert not s.suspect_interference()
+
+    def test_suspects_on_throughput_drop(self):
+        s = StrategyStat()
+        for _ in range(WARMUP_SAMPLES):
+            s.update(100_000, 0.01)  # 10 MB/s
+        assert not s.suspect_interference()
+        for _ in range(WARMUP_SAMPLES):
+            s.update(100_000, 1.0)  # 0.1 MB/s << 0.8x best
+        assert s.suspect_interference()
+
+    def test_steady_throughput_is_clean(self):
+        s = StrategyStat()
+        for _ in range(WARMUP_SAMPLES * 3):
+            s.update(100_000, 0.01)
+        assert not s.suspect_interference()
+        assert s.ema_throughput == pytest.approx(1e7, rel=0.01)
+
+    def test_zero_duration_ignored(self):
+        s = StrategyStat()
+        s.update(100, 0.0)
+        assert s.count == 0
+
+
+class TestAdaptiveState:
+    def test_advance_wraps_and_resets(self):
+        a = AdaptiveState(3)
+        a.current.update(100, 1.0)
+        assert a.active == 0 and a.current.count == 1
+        assert a.advance() == 1
+        assert a.current.count == 0  # fresh window
+        a.advance()
+        assert a.advance() == 0  # wraps
+        assert a.switch_count == 3
+
+    def test_summary_shape(self):
+        a = AdaptiveState(2)
+        s = a.summary()
+        assert s["active"] == 0 and len(s["stats"]) == 2
