@@ -1,13 +1,24 @@
-"""Benchmark: ResNet-50 training throughput (images/sec/chip) on TPU.
+"""Benchmark: ResNet-50 training throughput (images/sec/chip) on TPU,
+running through the framework's own training path.
 
 Prints ONE JSON line:
   {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+The step is built the way users build it: a `jax.sharding.Mesh` over all
+chips, `shard_map` SPMD, and the `synchronous_sgd` optimizer wrapper whose
+traced `pmean` is the framework's gradient AllReduce (one chip degenerates
+to an identity reduce, but the compiled program is the real S-SGD path).
+Cross-replica batch-norm stats are pmean-synced like the gradients.
 
 Baseline: the reference's headline workload is ResNet-50 synchronous SGD
 (README "Benchmark", 16x V100). Published-era per-GPU throughput for
 TF ResNet-50 fp32 on V100 is ~350 images/sec (the regime of the
 reference's charts, benchmarks/system/result/sync-scalability.svg);
-vs_baseline = our images/sec/chip / 350.
+vs_baseline = our images/sec/chip / 350. Both runs here are fp32
+parameters (matmuls ride the MXU in bf16 via XLA's default precision,
+the TPU-native equivalent of the V100's tensor-core fp16 accumulate).
+
+Second metric (resize latency, BASELINE.md north star #2): bench_resize.py.
 """
 
 from __future__ import annotations
@@ -19,34 +30,58 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
+from jax import lax, shard_map
+from jax.sharding import NamedSharding, PartitionSpec as P
 
 BASELINE_IMG_PER_SEC = 350.0  # TF ResNet-50 fp32 on V100, reference era
 
 
 def main() -> None:
     from kungfu_tpu.models.resnet import init_resnet, resnet50, resnet_loss
+    from kungfu_tpu.optimizers import synchronous_sgd
+    from kungfu_tpu.parallel import make_mesh
 
-    batch = 128
+    n_chips = jax.device_count()
+    per_chip_batch = 128
+    batch = per_chip_batch * n_chips
     image_size = 224
     model = resnet50(num_classes=1000)
     key = jax.random.PRNGKey(0)
     params, batch_stats = init_resnet(key, model, image_size, batch=2)
 
-    opt = optax.sgd(0.1, momentum=0.9)
+    mesh = make_mesh({"dp": n_chips})
+    opt = synchronous_sgd(optax.sgd(0.1, momentum=0.9), axis_name="dp")
     opt_state = opt.init(params)
 
-    images = jax.random.normal(key, (batch, image_size, image_size, 3), jnp.float32)
-    labels = jnp.zeros((batch,), jnp.int32)
-
-    @jax.jit
-    def step(params, batch_stats, opt_state, batch_data):
+    def local_step(params, batch_stats, opt_state, batch_data):
         def loss_fn(p):
             return resnet_loss(model, p, batch_stats, batch_data)
 
         (loss, new_stats), grads = jax.value_and_grad(loss_fn, has_aux=True)(params)
+        # synchronous_sgd's update pmeans the grads over dp (the AllReduce)
         updates, opt_state2 = opt.update(grads, opt_state, params)
         params = optax.apply_updates(params, updates)
-        return params, new_stats, opt_state2, loss
+        # cross-replica BN stats, like the gradient sync
+        new_stats = jax.tree.map(lambda x: lax.pmean(x, "dp"), new_stats)
+        return params, new_stats, opt_state2, lax.pmean(loss, "dp")
+
+    step = jax.jit(
+        shard_map(
+            local_step,
+            mesh=mesh,
+            in_specs=(P(), P(), P(), P("dp")),
+            out_specs=(P(), P(), P(), P()),
+            check_vma=False,
+        ),
+        donate_argnums=(0, 1, 2),
+    )
+
+    sharded = NamedSharding(mesh, P("dp"))
+    images = jax.device_put(
+        jax.random.normal(key, (batch, image_size, image_size, 3), jnp.float32),
+        sharded,
+    )
+    labels = jax.device_put(jnp.zeros((batch,), jnp.int32), sharded)
 
     # warmup/compile; device_get forces real completion (block_until_ready
     # does not block on the axon tunnel backend)
@@ -66,12 +101,11 @@ def main() -> None:
     dt = time.perf_counter() - t0
 
     img_per_sec = batch * iters / dt
-    n_chips = jax.device_count()
     per_chip = img_per_sec / n_chips
     print(
         json.dumps(
             {
-                "metric": "resnet50_train_throughput_per_chip",
+                "metric": "resnet50_ssgd_train_throughput_per_chip",
                 "value": round(per_chip, 2),
                 "unit": "images/sec/chip",
                 "vs_baseline": round(per_chip / BASELINE_IMG_PER_SEC, 3),

@@ -1,0 +1,104 @@
+"""Benchmark: elastic resize latency on a localhost CPU cluster.
+
+Prints ONE JSON line:
+  {"metric": "elastic_resize_latency", "value": N, "unit": "ms", ...}
+
+Parity: the reference's resize-latency harness ("resize %d -> %d took %s",
+benchmarks/adaptation/adaptive_trainer.py:98-103 + the ResizeProfiler in
+experimental/hook/elastic.py) — BASELINE.md's second north-star metric.
+Latency = wall time of one propose->consensus->respawn->rejoin->barrier
+cycle as observed by a surviving worker (from calling resize to the new
+session's first completed collective).
+
+vs_baseline: the reference publishes no number; we report the measured
+value with vs_baseline=1.0 as the self-referenced anchor for tracking
+regressions round over round.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.abspath(__file__))
+
+AGENT = r'''
+import sys, time
+import numpy as np
+from kungfu_tpu import api
+from kungfu_tpu.elastic.state import ElasticState
+
+SIZES = [2, 3, 4, 2, 3, 4, 2]
+es = ElasticState(max_progress=len(SIZES) * 10)
+t_resize = None
+while not es.stopped():
+    with es.scope():
+        rank, size = api.current_rank(), api.cluster_size()
+        step = es.progress
+        if step % 10 == 0 and rank == 0:
+            target = SIZES[(step // 10) % len(SIZES)]
+            if target != size:
+                api.propose_new_size(target)
+        t0 = time.perf_counter()
+        before = size
+        es.end(1)
+        # es.end ran resize(); if membership changed, the new session's
+        # barrier already completed inside _update_to -> this is the full
+        # resize cost as seen by a survivor
+        if not es.stopped() and api.cluster_size() != before:
+            dt = (time.perf_counter() - t0) * 1000
+            print(f"RESIZE {before} -> {api.cluster_size()} took {dt:.1f} ms",
+                  flush=True)
+print(f"done rank={api.current_rank()} reason={es.stop_reason}", flush=True)
+'''
+
+
+def main() -> None:
+    with tempfile.NamedTemporaryFile("w", suffix=".py", delete=False) as f:
+        f.write(AGENT)
+        agent_path = f.name
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    try:
+        r = subprocess.run(
+            [
+                sys.executable, "-m", "kungfu_tpu.runner.cli",
+                "-np", "2",
+                "-H", "127.0.0.1:4",
+                "-w",
+                "-builtin-config-port", "0",
+                "--", sys.executable, agent_path,
+            ],
+            env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+        )
+    finally:
+        os.unlink(agent_path)
+    lat = [float(m) for m in re.findall(r"took ([0-9.]+) ms", r.stdout)]
+    if r.returncode != 0 or not lat:
+        print(json.dumps({
+            "metric": "elastic_resize_latency",
+            "value": -1,
+            "unit": "ms",
+            "vs_baseline": 0,
+            "error": (r.stdout + r.stderr)[-400:],
+        }))
+        sys.exit(1)
+    lat.sort()
+    median = lat[len(lat) // 2]
+    print(json.dumps({
+        "metric": "elastic_resize_latency",
+        "value": round(median, 1),
+        "unit": "ms",
+        "vs_baseline": 1.0,
+        "n_resizes": len(lat),
+        "min_ms": round(lat[0], 1),
+        "max_ms": round(lat[-1], 1),
+    }))
+
+
+if __name__ == "__main__":
+    main()

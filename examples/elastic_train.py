@@ -11,10 +11,16 @@ then grow/shrink the cluster from another terminal:
       ["127.0.0.1:38000","127.0.0.1:38001","127.0.0.1:38002"]}' \\
       http://127.0.0.1:9100/config
 
-Workers re-sync progress via int-max allreduce and keep training; removed
-workers detach and exit. (Host/DCN plane only — single-chip compute per
-worker. On a TPU pod, pair this with reload-mode restarts so each epoch
-gets a fresh ICI mesh.)
+Synchronous data parallelism on the HOST plane: gradients are averaged
+across the (possibly just-resized) cluster every step; joining workers
+inherit rank-0's live params + optimizer state via the ElasticState
+re-sync broadcast (no per-step model averaging, no fresh-init
+contamination). The elastic dataset resumes from the synced progress so
+no sample is skipped or double-trained across resizes.
+
+On a TPU pod, run with -elastic-mode reload and initialize_device_plane()
+so each membership epoch gets a fresh ICI mesh; the ElasticState /
+dataset logic is identical (see tests/integration/reload_agent.py).
 """
 
 import argparse
@@ -25,49 +31,61 @@ import numpy as np
 import optax
 
 from kungfu_tpu import api
-from kungfu_tpu.elastic.state import ElasticState
+from kungfu_tpu.elastic import ElasticDataset, ElasticState
 from kungfu_tpu.models.mlp import init_mlp, mlp_loss
+from kungfu_tpu.ops.collective import fuse_pytree
+
+
+def synthetic_mnist(n=4096, seed=0):
+    rng = np.random.default_rng(seed)
+    x = rng.normal(size=(n, 784)).astype(np.float32)
+    w = np.random.default_rng(seed + 1).normal(size=(784, 10)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1)
+    return x, y
 
 
 def main():
     p = argparse.ArgumentParser()
-    p.add_argument("--steps", type=int, default=100)
+    p.add_argument("--samples", type=int, default=20_000)
     p.add_argument("--batch", type=int, default=64)
+    p.add_argument("--lr", type=float, default=0.5)
     args = p.parse_args()
 
-    rank = api.current_rank()
+    x, y = synthetic_mnist()
+    ds = ElasticDataset([x, y], args.batch, seed=1)
     params = init_mlp(jax.random.PRNGKey(0))
-    opt = optax.sgd(0.1)
-    state = opt.init(params)
+    opt = optax.sgd(args.lr)
+    opt_state = opt.init(params)
 
     @jax.jit
-    def local_step(params, state, batch):
-        loss, grads = jax.value_and_grad(mlp_loss)(params, batch)
-        updates, state = opt.update(grads, state, params)
-        return optax.apply_updates(params, updates), state, loss
+    def grads_fn(params, batch):
+        return jax.value_and_grad(mlp_loss)(params, batch)
 
-    rng = np.random.default_rng(rank)
-    es = ElasticState(max_progress=args.steps)
+    @jax.jit
+    def apply_fn(params, opt_state, grads):
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state
+
+    state = {"params": params, "opt": opt_state}
+    es = ElasticState(max_progress=args.samples)
+    es.register_state(lambda: state, lambda t: state.update(t))
+
     while not es.stopped():
         with es.scope():
-            x = jnp.asarray(rng.normal(size=(args.batch, 784)), jnp.float32)
-            y = jnp.asarray(rng.integers(0, 10, args.batch))
-            params, state, loss = local_step(params, state, (x, y))
-            # average the models across the (possibly just-resized) cluster
-            flat = np.concatenate(
-                [np.ravel(np.asarray(l, np.float32)) for l in jax.tree.leaves(params)]
+            rank, size = api.current_rank(), api.cluster_size()
+            xb, yb = ds.batch_at(es.progress, rank, size)
+            loss, grads = grads_fn(state["params"], (jnp.asarray(xb), jnp.asarray(yb)))
+            # S-SGD: average GRADIENTS across the cluster (host/DCN plane)
+            fused, unflatten = fuse_pytree(grads)
+            flat = np.asarray(fused, np.float32)
+            avg = api.all_reduce_array(flat, name=f"g{es.progress}") / size
+            state["params"], state["opt"] = apply_fn(
+                state["params"], state["opt"], unflatten(avg)
             )
-            avg = api.all_reduce_array(flat, name="model-avg") / api.cluster_size()
-            leaves = jax.tree.leaves(params)
-            out, off = [], 0
-            for l in leaves:
-                out.append(jnp.asarray(avg[off:off + l.size].reshape(l.shape)))
-                off += l.size
-            params = jax.tree.unflatten(jax.tree.structure(params), out)
-            if rank == 0 and es.progress % 20 == 0:
-                print(f"step {es.progress}: loss {float(loss):.4f} np={api.cluster_size()}")
-            es.end(1)
-    print(f"rank {rank}: {es.stop_reason} at progress {es.progress}")
+            if rank == 0 and (es.progress // ds.cluster_delta(size)) % 20 == 0:
+                print(f"progress {es.progress}: loss {float(loss):.4f} np={size}")
+            es.end(ds.cluster_delta(size))
+    print(f"rank {api.current_rank()}: {es.stop_reason} at progress {es.progress}")
 
 
 if __name__ == "__main__":

@@ -266,23 +266,34 @@ class HostSession:
         self.all_reduce(Workspace(x, out2, ReduceOp.MAX, f":consensus:max:{name}"))
         return bool(np.array_equal(out1, out2))
 
-    def broadcast_bytes(self, bs: bytes, name: str) -> bytes:
-        """Broadcast variable-length bytes from rank 0 (two graph walks:
+    def broadcast_bytes(self, bs: bytes, name: str, root: int = 0) -> bytes:
+        """Broadcast variable-length bytes from `root` (two graph walks:
         length, then payload). Used to bootstrap the device plane — the
         TPU analog of broadcasting the NCCL unique id over the CPU
-        collective (gpu_collective.cpp:190-212)."""
-        n_send = np.array([len(bs) if self.rank == 0 else 0], np.int64)
+        collective (gpu_collective.cpp:190-212) — and for elastic state
+        re-sync, where the root must be a SURVIVING peer (not necessarily
+        rank 0 of the new cluster)."""
+        from kungfu_tpu.plan import topology as _topo
+
+        # a fixed star keeps the walk root-correct regardless of the active
+        # strategy (set_tree/adaptive switches may re-root global_strategies)
+        graph = _topo.gen_star_bcast_graph(self.size, root)
+        n_send = np.array([len(bs) if self.rank == root else 0], np.int64)
         n_recv = np.zeros(1, np.int64)
-        self.broadcast(Workspace(n_send, n_recv, ReduceOp.SUM, f"{name}:len"))
+        self._run_graphs(
+            Workspace(n_send, n_recv, ReduceOp.SUM, f"{name}:len"), [graph]
+        )
         n = int(n_recv[0])
         if n == 0:
             return b""
-        if self.rank == 0:
+        if self.rank == root:
             send = np.frombuffer(bs, np.uint8)
         else:
             send = np.zeros(n, np.uint8)
         recv = np.zeros(n, np.uint8)
-        self.broadcast(Workspace(send, recv, ReduceOp.SUM, f"{name}:data"))
+        self._run_graphs(
+            Workspace(send, recv, ReduceOp.SUM, f"{name}:data"), [graph]
+        )
         return recv.tobytes()
 
     def gather(self, w: Workspace) -> None:

@@ -1,0 +1,17 @@
+from kungfu_tpu.elastic.configserver import ConfigServer
+from kungfu_tpu.elastic.dataset import ElasticDataset
+from kungfu_tpu.elastic.schedule import (
+    StepBasedSchedule,
+    parse_schedule,
+    schedule_target,
+)
+from kungfu_tpu.elastic.state import ElasticState
+
+__all__ = [
+    "ConfigServer",
+    "ElasticDataset",
+    "ElasticState",
+    "StepBasedSchedule",
+    "parse_schedule",
+    "schedule_target",
+]

@@ -1,0 +1,75 @@
+"""Step-based elastic schedules: "np:steps,np:steps,..." driving resizes.
+
+Capability parity: KungfuStepBasedSchedule (ops/cpu/elastic.cpp:16-81) +
+KungFuElasticTrainHook (hooks/elastic.py:14-88) — a declarative schedule
+of cluster sizes by global step; rank 0 publishes the target size to the
+config server at each boundary and every worker resizes via consensus.
+"""
+
+from __future__ import annotations
+
+from typing import List, Optional, Tuple
+
+from kungfu_tpu import api
+
+
+def parse_schedule(spec: str) -> List[Tuple[int, int]]:
+    """"2:10,4:20,1:5" -> [(2,10), (4,20), (1,5)]: np for a span of steps."""
+    out: List[Tuple[int, int]] = []
+    for part in spec.split(","):
+        part = part.strip()
+        if not part:
+            continue
+        np_s, _, steps_s = part.partition(":")
+        n, steps = int(np_s), int(steps_s)
+        if n <= 0 or steps <= 0:
+            raise ValueError(f"bad schedule entry {part!r}: sizes/spans must be > 0")
+        out.append((n, steps))
+    if not out:
+        raise ValueError(f"empty schedule: {spec!r}")
+    return out
+
+
+def schedule_target(schedule: List[Tuple[int, int]], step: int) -> Optional[int]:
+    """Desired cluster size at `step`; None once the schedule is exhausted
+    (training continues at the last size)."""
+    off = 0
+    for n, steps in schedule:
+        if step < off + steps:
+            return n
+        off += steps
+    return None
+
+
+class StepBasedSchedule:
+    """Drives propose_new_size from a schedule inside the elastic loop:
+
+        sched = StepBasedSchedule("2:10,4:20,1:5")
+        while not es.stopped():
+            with es.scope():
+                sched.maybe_propose(es.progress)
+                ...
+                es.end(1)
+
+    Only rank 0 publishes; the resize itself still flows through the config
+    server + consensus like any other elastic event.
+    """
+
+    def __init__(self, spec: str):
+        self.schedule = parse_schedule(spec)
+        self._last_proposed: Optional[int] = None
+
+    def total_steps(self) -> int:
+        return sum(steps for _, steps in self.schedule)
+
+    def maybe_propose(self, step: int) -> Optional[int]:
+        """Publish the scheduled size if it changed; returns the size
+        proposed (or None)."""
+        target = schedule_target(self.schedule, step)
+        if target is None or target == self._last_proposed:
+            return None
+        self._last_proposed = target
+        if api.current_rank() == 0 and target != api.cluster_size():
+            api.propose_new_size(target)
+            return target
+        return None

@@ -1,21 +1,43 @@
 """ElasticState: progress-based elastic training loop driver.
 
-Capability parity: srcs/python/kungfu/python/elastic_state.py:4-79 —
+Capability parity: srcs/python/kungfu/python/elastic_state.py:4-79 +
+KungFuElasticTrainHook's state re-sync (hooks/elastic.py:46-57) —
   es = ElasticState(max_progress)
+  es.register_state(get_state, set_state)   # joiner weight re-sync
   while not es.stopped():
-      with es.scope():          # begin(): sync progress after resize
+      with es.scope():          # begin(): sync progress + state after resize
           train_one_batch()
           es.end(batch_size)    # progress += n, maybe resize
                                 # (es.advance is an alias for es.end)
 Stop reasons: 'finished' | 'detached' | 'reload'.
+
+After every membership change begin() (a) adopts the cluster-max progress
+via an int-max allreduce and (b) if state callbacks are registered,
+broadcasts rank-0's training state over the host plane so joining workers
+inherit live weights instead of fresh-initialized ones (the reference
+re-broadcasts variables + re-syncs progress in its elastic hook).
 """
 
 from __future__ import annotations
 
 import contextlib
-from typing import Optional
+import io
+from typing import Callable, Optional
+
+import numpy as np
 
 from kungfu_tpu import api
+
+
+def _pack_leaves(leaves) -> bytes:
+    buf = io.BytesIO()
+    np.savez(buf, *[np.asarray(l) for l in leaves])
+    return buf.getvalue()
+
+
+def _unpack_leaves(blob: bytes, n: int):
+    with np.load(io.BytesIO(blob)) as z:
+        return [z[f"arr_{i}"] for i in range(n)]
 
 
 class ElasticState:
@@ -28,11 +50,73 @@ class ElasticState:
         self.progress = self._peer.config.init_progress
         self._synced = False
         self._stop_reason: Optional[str] = None
+        self._get_state: Optional[Callable] = None
+        self._set_state: Optional[Callable] = None
+
+    def register_state(self, get_state: Callable, set_state: Callable) -> None:
+        """Register training-state callbacks for joiner re-sync.
+
+        get_state() -> pytree of arrays (params + optimizer state);
+        set_state(pytree) installs the received values. Called only after
+        membership changes, never in the steady-state step path.
+        """
+        self._get_state = get_state
+        self._set_state = set_state
+
+    def _sync_state(self) -> None:
+        if self._get_state is None:
+            return
+        import jax
+
+        from kungfu_tpu.base.ops import ReduceOp
+        from kungfu_tpu.base.workspace import Workspace
+
+        sess = self._peer.current_session()
+        if sess.size == 1:
+            return
+        # Pick a provably SURVIVING broadcast root: the new cluster's order
+        # comes verbatim from the user's config PUT, so rank 0 may be a
+        # fresh joiner whose state must never overwrite the survivors'.
+        # Each peer votes (its rank if it lived through a previous epoch);
+        # the min survivor rank becomes the root. Two more scalars ride the
+        # same vote: the joiner count (a pure shrink has none -> skip the
+        # broadcast entirely) gated by the MIN below.
+        big = np.int64(1 << 30)
+        survivor = self._peer.epoch_count > 1
+        v = f"v{self._peer.cluster_version}"
+        root_in = np.array([sess.rank if survivor else big], np.int64)
+        root_out = np.zeros(1, np.int64)
+        sess.all_reduce(
+            Workspace(root_in, root_out, ReduceOp.MIN, f"kungfu::syncroot:{v}")
+        )
+        fresh_in = np.array([0 if survivor else 1], np.int64)
+        fresh_out = np.zeros(1, np.int64)
+        sess.all_reduce(
+            Workspace(fresh_in, fresh_out, ReduceOp.SUM, f"kungfu::syncfresh:{v}")
+        )
+        n_fresh = int(fresh_out[0])
+        if n_fresh == 0:
+            return  # pure shrink: survivors are already in sync
+        # fresh world (startup / reload): root 0 = initializer broadcast
+        root = int(root_out[0]) if root_out[0] < big else 0
+        tree = self._get_state()
+        leaves, treedef = jax.tree.flatten(tree)
+        blob = _pack_leaves(leaves) if sess.rank == root else b""
+        got = sess.broadcast_bytes(blob, f"kungfu::statesync:{v}", root=root)
+        if sess.rank != root and self._set_state is not None:
+            new_leaves = _unpack_leaves(got, len(leaves))
+            new_leaves = [
+                np.asarray(nl).astype(np.asarray(ol).dtype).reshape(np.shape(ol))
+                for nl, ol in zip(new_leaves, leaves)
+            ]
+            self._set_state(jax.tree.unflatten(treedef, new_leaves))
 
     def begin(self) -> None:
         if not self._synced:
             # after a membership change, everyone adopts the max progress
+            # and rank-0's live training state
             self.progress = api.all_reduce_int_max(self.progress)
+            self._sync_state()
             self._synced = True
 
     def end(self, delta: int = 1) -> None:

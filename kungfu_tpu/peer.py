@@ -71,6 +71,10 @@ class Peer:
         self._session_lock = threading.RLock()
         self._updated = True
         self._persisted_tree: Optional[list] = None
+        # number of cluster epochs this PROCESS has lived through; 1 after
+        # startup, >1 once it survives a delta resize. Lets elastic state
+        # sync pick a provably surviving broadcast root.
+        self.epoch_count = 0
 
         self.store = BlobStore()
         self.client = Client(self.self_id, use_unix=not config.single_process)
@@ -152,6 +156,7 @@ class Peer:
                 else:
                     self._persisted_tree = None
             self._peers = peers
+            self.epoch_count += 1
         if not self.config.single_process:
             self._session.barrier(tag=f":v{self.cluster_version}")
         self._updated = True
@@ -170,10 +175,12 @@ class Peer:
     def _notify_runners(self, stage: dict) -> None:
         """Send the new Stage to every runner (parity: peer.go:200-214)."""
         payload = json.dumps(stage).encode()
+        log.debug("notifying %d runners: v%s", len(self.config.runners), stage.get("Version"))
         for runner in self.config.runners:
             if not self.client.wait_peer(runner, timeout=30):
                 raise ConnectionError(f"runner {runner} unreachable")
             self.client.send(runner, "update", payload, ConnType.CONTROL)
+            log.debug("notified runner %s", runner)
 
     def _propose(self, cluster: Cluster, progress: int = 0) -> Tuple[bool, bool]:
         """Consensus-check and adopt a new cluster.

@@ -113,20 +113,33 @@ class Watcher:
         server = Server(PeerID(self.self_host, self.args.runner_port), use_unix=False)
         server.register(ConnType.CONTROL, self.handle_control)
         server.start()
+        idle_since: Optional[float] = None
         try:
             self.apply_delta(initial)
             while not self.done.is_set():
                 try:
                     stage = self.stage_q.get(timeout=0.5)
                 except queue.Empty:
-                    # exit when all local workers have finished
+                    # Exit when all local workers have finished. In reload
+                    # mode only, wait out a drain grace first: workers
+                    # notify the runner and exit immediately, so the final
+                    # Stage can still be in flight when the last proc dies —
+                    # concluding too early drops the reload and strands the
+                    # cluster. Delta-mode exits stay prompt.
+                    grace = 2.0 if self.args.elastic_mode == "reload" else 0.0
                     if self.current and all(not p.running for p in self.current.values()):
-                        codes = [p.proc.returncode for p in self.current.values()]
-                        self.exit_code = 0 if all(c == 0 for c in codes) else 1
-                        break
+                        if idle_since is None:
+                            idle_since = time.monotonic()
+                        if time.monotonic() - idle_since >= grace:
+                            codes = [p.proc.returncode for p in self.current.values()]
+                            self.exit_code = 0 if all(c == 0 for c in codes) else 1
+                            break
+                    else:
+                        idle_since = None
                     # reap detached workers
                     self._gone = [p for p in self._gone if p.running]
                     continue
+                idle_since = None
                 if stage.reload:
                     self.apply_full(stage)
                 else:

@@ -55,10 +55,24 @@ class Server:
         with self._lock:
             return self._token
 
-    def start(self) -> None:
+    def start(self, bind_timeout: float = 15.0) -> None:
         tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
-        tcp.bind(("0.0.0.0", self.self_id.port))
+        # Bind retry: after an elastic shrink-then-grow, a respawned worker
+        # can race the previous incarnation's exit for the same port (the
+        # watcher does not serialize spawn against the detached process's
+        # teardown).
+        import time as _time
+
+        deadline = _time.monotonic() + bind_timeout
+        while True:
+            try:
+                tcp.bind(("0.0.0.0", self.self_id.port))
+                break
+            except OSError:
+                if _time.monotonic() >= deadline:
+                    raise
+                _time.sleep(0.25)
         tcp.listen(128)
         self._listeners.append(tcp)
         t = threading.Thread(target=self._accept_loop, args=(tcp,), daemon=True)
@@ -87,6 +101,9 @@ class Server:
             except OSError:
                 pass
         if self._use_unix:
+            # NOTE: if a respawned same-port worker already re-bound this
+            # path, this unlink removes ITS socket file; clients then fall
+            # back to TCP (correct, just slower) until the next epoch.
             try:
                 os.unlink(unix_sock_path(self.self_id))
             except FileNotFoundError:

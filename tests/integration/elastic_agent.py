@@ -8,6 +8,8 @@ workers detach and exit cleanly.
 
 import sys
 
+import numpy as np
+
 from kungfu_tpu import api
 from kungfu_tpu.elastic.state import ElasticState
 
@@ -17,10 +19,21 @@ MAX_PROGRESS = 40
 
 def main() -> int:
     es = ElasticState(max_progress=MAX_PROGRESS)
+    # fresh workers start with a sentinel "model"; after the begin() sync a
+    # joiner must hold rank-0's live state, never the fresh init
+    # (parity: KungFuElasticTrainHook re-broadcast, hooks/elastic.py:46-57)
+    model = {"w": np.full(4, -1.0, np.float64)}
+    es.register_state(lambda: model, lambda tree: model.update(tree))
     while not es.stopped():
         with es.scope():
             rank = api.current_rank()
             size = api.cluster_size()
+            if es.progress > 1:
+                assert model["w"][0] >= 0.0, (
+                    f"rank {rank} joined at progress {es.progress} with "
+                    f"fresh-initialized state {model['w'][0]}"
+                )
+            model["w"][:] = float(es.progress)  # "training" advances state
             if es.progress > 0 and es.progress % 10 == 0 and rank == 0:
                 target = SIZES[(es.progress // 10) % len(SIZES)]
                 if target != size:

@@ -1,0 +1,69 @@
+"""Elastic agent where the config PUT lists the JOINER first, making it
+rank 0 of the new cluster. The state re-sync must still broadcast from a
+SURVIVOR (min surviving rank), never from the fresh joiner — otherwise
+the joiner's fresh-initialized weights silently reset training.
+"""
+
+import json
+import sys
+import urllib.request
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.elastic.state import ElasticState
+from kungfu_tpu.peer import get_default_peer
+
+MAX_PROGRESS = 24
+
+
+def put_joiner_first_cluster() -> None:
+    """Grow by one worker, listed FIRST (becomes rank 0)."""
+    peer = get_default_peer()
+    from kungfu_tpu.plan.cluster import Cluster
+
+    current = Cluster(runners=peer.config.runners, workers=peer._peers)
+    grown = current.resize(len(peer._peers) + 1)
+    added = [w for w in grown.workers if w not in list(peer._peers)]
+    reordered = added + [w for w in grown.workers if w not in added]
+    payload = json.dumps(
+        {
+            "Runners": [str(r) for r in grown.runners],
+            "Workers": [str(w) for w in reordered],
+        }
+    ).encode()
+    req = urllib.request.Request(
+        peer.config.config_server, data=payload, method="PUT"
+    )
+    with urllib.request.urlopen(req, timeout=5) as resp:
+        resp.read()
+
+
+def main() -> int:
+    es = ElasticState(max_progress=MAX_PROGRESS)
+    model = {"w": np.full(2, -1.0, np.float64)}
+    es.register_state(lambda: model, lambda t: model.update(t))
+    proposed = False
+    while not es.stopped():
+        with es.scope():
+            rank, size = api.current_rank(), api.cluster_size()
+            if es.progress > 1:
+                # EVERY worker (survivors included!) must hold live state;
+                # a fresh-joiner broadcast would reset survivors to -1
+                assert model["w"][0] >= 0.0, (
+                    f"rank {rank} state reset to {model['w'][0]} at "
+                    f"progress {es.progress} — joiner overwrote survivors"
+                )
+            model["w"][:] = float(es.progress)
+            if es.progress == 10 and not proposed and size == 2:
+                proposed = True
+                # the CURRENT rank 0 publishes the adversarial ordering
+                if rank == 0:
+                    put_joiner_first_cluster()
+            es.end(1)
+    print(f"OK joiner-first rank={api.current_rank()} reason={es.stop_reason}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Schedule-driven elastic training: a step-based np schedule drives
+resizes while an MLP trains on an elastic dataset; the run must converge.
+
+Parity: KungFuElasticTrainHook + KungfuStepBasedSchedule
+(hooks/elastic.py:14-88, ops/cpu/elastic.cpp:16-81) and the elastic
+dataset adaptor (v1/datasets/adaptor.py).
+"""
+
+import sys
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import optax
+
+from kungfu_tpu import api
+from kungfu_tpu.elastic import ElasticDataset, ElasticState, StepBasedSchedule
+from kungfu_tpu.models.mlp import init_mlp, mlp_loss
+
+BATCH = 32
+N_SAMPLES = 1024
+# np:progress-span (samples): 2 workers, then 3, then back to 2
+SCHEDULE = f"2:{BATCH * 2 * 10},3:{BATCH * 3 * 10},2:{BATCH * 2 * 30}"
+
+
+def make_data():
+    rng = np.random.default_rng(42)
+    x = rng.normal(size=(N_SAMPLES, 784)).astype(np.float32)
+    w = np.random.default_rng(43).normal(size=(784, 10)).astype(np.float32)
+    y = np.argmax(x @ w, axis=1)
+    return x, y
+
+
+def main() -> int:
+    x, y = make_data()
+    ds = ElasticDataset([x, y], BATCH, seed=7)
+    params = init_mlp(jax.random.PRNGKey(0))
+    opt = optax.sgd(0.5)
+    opt_state = opt.init(params)
+
+    @jax.jit
+    def local_step(params, opt_state, batch):
+        loss, grads = jax.value_and_grad(mlp_loss)(params, batch)
+        updates, opt_state = opt.update(grads, opt_state, params)
+        return optax.apply_updates(params, updates), opt_state, loss
+
+    sched = StepBasedSchedule(SCHEDULE)
+    es = ElasticState(max_progress=sched.total_steps())
+
+    state = {"params": params, "opt": opt_state}
+    es.register_state(
+        lambda: state,
+        lambda tree: state.update(
+            {"params": tree["params"], "opt": tree["opt"]}
+        ),
+    )
+
+    first_loss = None
+    last_loss = None
+    while not es.stopped():
+        with es.scope():
+            rank = api.current_rank()
+            size = api.cluster_size()
+            sched.maybe_propose(es.progress)
+            xb, yb = ds.batch_at(es.progress, rank, size)
+            p, o, loss = local_step(
+                state["params"], state["opt"], (jnp.asarray(xb), jnp.asarray(yb))
+            )
+            # gradient sync: average the updated models over the host plane
+            # (this agent trains on the HOST plane; device-plane training is
+            # covered by device_agent/reload_agent)
+            flat = np.concatenate(
+                [np.ravel(np.asarray(l, np.float32)) for l in jax.tree.leaves(p)]
+            )
+            avg = api.all_reduce_array(flat, name=f"sync{es.progress}") / size
+            leaves, treedef = jax.tree.flatten(p)
+            out, off = [], 0
+            for l in leaves:
+                out.append(jnp.asarray(avg[off:off + l.size].reshape(l.shape)))
+                off += l.size
+            state["params"] = jax.tree.unflatten(treedef, out)
+            state["opt"] = o
+            loss = float(loss)
+            if first_loss is None:
+                first_loss = loss
+            last_loss = loss
+            es.end(ds.cluster_delta(size))
+
+    print(
+        f"done rank={api.current_rank()} reason={es.stop_reason} "
+        f"first_loss={first_loss:.4f} last_loss={last_loss:.4f}",
+        flush=True,
+    )
+    assert es.stop_reason in ("finished", "detached")
+    if es.stop_reason == "finished":
+        assert last_loss < 0.5 * first_loss, (
+            f"no convergence across resizes: {first_loss} -> {last_loss}"
+        )
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,103 @@
+"""Step-based schedule parsing + elastic dataset unit tests, and the
+schedule-driven elastic training e2e.
+
+Parity: ops/cpu/elastic.cpp:16-81 (schedule), v1/datasets/adaptor.py
+(elastic dataset), hooks/elastic.py (schedule-driven training).
+"""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.elastic.dataset import ElasticDataset
+from kungfu_tpu.elastic.schedule import parse_schedule, schedule_target
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AGENT = os.path.join(REPO, "tests", "integration", "schedule_agent.py")
+
+
+class TestSchedule:
+    def test_parse(self):
+        assert parse_schedule("2:10,4:20,1:5") == [(2, 10), (4, 20), (1, 5)]
+        assert parse_schedule(" 3:7 ") == [(3, 7)]
+
+    def test_parse_rejects_garbage(self):
+        for bad in ("", "0:5", "2:-1", "2:0", "x:1"):
+            with pytest.raises(ValueError):
+                parse_schedule(bad)
+
+    def test_target_by_step(self):
+        s = parse_schedule("2:10,4:20,1:5")
+        assert schedule_target(s, 0) == 2
+        assert schedule_target(s, 9) == 2
+        assert schedule_target(s, 10) == 4
+        assert schedule_target(s, 29) == 4
+        assert schedule_target(s, 30) == 1
+        assert schedule_target(s, 34) == 1
+        assert schedule_target(s, 35) is None  # exhausted
+
+
+class TestElasticDataset:
+    def _ds(self, n=100, b=8):
+        x = np.arange(n)
+        return ElasticDataset([x], b, seed=1)
+
+    def test_batches_partition_cluster_step(self):
+        """One cluster step at size k covers k disjoint batches."""
+        ds = self._ds()
+        got = np.concatenate(
+            [ds.batch_at(0, r, 4)[0] for r in range(4)]
+        )
+        assert len(set(got.tolist())) == 32  # no duplicates within the step
+
+    def test_progress_continuity_across_resize(self):
+        """Samples consumed before and after a resize don't overlap within
+        one epoch."""
+        ds = self._ds(n=1000, b=10)
+        before = np.concatenate(
+            [ds.batch_at(0, r, 2)[0] for r in range(2)]
+        )  # progress 0..20
+        after = np.concatenate(
+            [ds.batch_at(20, r, 3)[0] for r in range(3)]
+        )  # progress 20..50 on the grown cluster
+        assert not set(before.tolist()) & set(after.tolist())
+
+    def test_epoch_wrap(self):
+        ds = self._ds(n=10, b=8)
+        (b,) = ds.batch_at(8, 0, 1)  # crosses into epoch 1
+        assert len(b) == 8
+        assert all(0 <= v < 10 for v in b)
+
+    def test_deterministic(self):
+        a = self._ds().batch_at(16, 1, 2)[0]
+        b = self._ds().batch_at(16, 1, 2)[0]
+        assert np.array_equal(a, b)
+
+    def test_rejects_mismatched_arrays(self):
+        with pytest.raises(ValueError):
+            ElasticDataset([np.arange(4), np.arange(5)], 2)
+
+    def test_cluster_delta(self):
+        assert self._ds(b=8).cluster_delta(4) == 32
+
+
+def test_schedule_driven_elastic_training_converges():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2",
+            "-H", "127.0.0.1:4",
+            "-w",
+            "-builtin-config-port", "0",
+            "--", sys.executable, AGENT,
+        ],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    finished = [l for l in r.stdout.splitlines() if "reason=finished" in l]
+    assert len(finished) == 2, r.stdout  # final size per the schedule

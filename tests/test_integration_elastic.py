@@ -13,6 +13,9 @@ import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 AGENT = os.path.join(REPO, "tests", "integration", "elastic_agent.py")
+JOINER_FIRST_AGENT = os.path.join(
+    REPO, "tests", "integration", "joiner_first_agent.py"
+)
 
 
 def test_elastic_resize_schedule():
@@ -35,3 +38,24 @@ def test_elastic_resize_schedule():
         cwd=REPO,
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+
+
+def test_joiner_listed_first_cannot_reset_survivor_state():
+    """A config PUT that puts the joiner at rank 0 must not let its fresh
+    weights overwrite the survivors' (state re-sync roots at a survivor)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2",
+            "-H", "127.0.0.1:4",
+            "-w",
+            "-builtin-config-port", "0",
+            "--", sys.executable, JOINER_FIRST_AGENT,
+        ],
+        env=env, capture_output=True, text=True, timeout=220, cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    oks = [l for l in r.stdout.splitlines() if "OK joiner-first" in l]
+    assert len(oks) == 3, r.stdout
