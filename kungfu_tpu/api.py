@@ -9,6 +9,7 @@ ctypes into libkungfu.
 from __future__ import annotations
 
 import atexit
+import threading
 from typing import Optional, Sequence
 
 import numpy as np
@@ -16,6 +17,7 @@ import numpy as np
 from kungfu_tpu.base.ops import ReduceOp
 from kungfu_tpu.base.workspace import Workspace
 from kungfu_tpu.peer import finalize_default_peer, get_default_peer
+from kungfu_tpu.transport.message import ConnType as _ConnType
 
 atexit.register(finalize_default_peer)
 
@@ -164,7 +166,9 @@ def optimized_tree(samples: int = 3) -> list:
 
 
 def set_tree(fathers) -> None:
-    """Install + persist a collective forest (parity: SetTree op)."""
+    """Install a collective tree for the current epoch (parity: SetTree
+    op); a resize reverts to the configured strategy — re-probe with
+    optimized_tree() after membership changes."""
     get_default_peer().set_tree(fathers)
 
 
@@ -172,14 +176,20 @@ def get_neighbour(step: int) -> int:
     """Deterministic partner schedule: at step t, pair with the peer whose
     rank differs in bit position (t mod log2-ceiling) — a hypercube-style
     schedule giving each peer a distinct partner per step (capability
-    parity: GetNeighbour op for PairAveraging peer selection)."""
+    parity: GetNeighbour op for PairAveraging peer selection). On
+    non-power-of-two clusters an out-of-range hypercube partner falls back
+    to the round-robin schedule, so the result is always a VALID peer and
+    never self (the reference's GetNeighbour has the same guarantee)."""
     sess = get_default_peer().current_session()
     n, r = sess.size, sess.rank
     if n == 1:
         return 0
     bits = max(1, (n - 1).bit_length())
     partner = r ^ (1 << (step % bits))
-    return partner if partner < n else r
+    if partner < n:
+        return partner
+    # fallback: (r+1+k) % n with k <= n-2 can never wrap onto r
+    return (r + 1 + step % (n - 1)) % n
 
 
 def round_robin_peer(step: int) -> int:
@@ -199,6 +209,57 @@ def egress_rates() -> "np.ndarray":
 
     sess = get_default_peer().current_session()
     return np.asarray(get_monitor().egress_rates(list(sess.peers)), np.float64)
+
+
+_queue_ids: dict = {}
+_queue_lock = threading.Lock()
+
+
+def new_queue(src: int, dst: int) -> int:
+    """Allocate the next queue id for the (src, dst) peer pair.
+
+    Parity: NewQueue (ops/cpu/queue.cpp:7-44 + libkungfu-comm/queue.go):
+    both endpoints call new_queue in the same program order, so each side's
+    local counter yields matching ids without any wire traffic. Counters
+    are scoped to the cluster epoch — after an elastic resize the rank
+    space changes, so every peer restarts the pair counters from 0 (stale
+    cross-epoch messages are already fenced by the transport token).
+    """
+    version = get_default_peer().cluster_version
+    with _queue_lock:
+        for k in [k for k in _queue_ids if k[0] != version]:
+            del _queue_ids[k]  # only one epoch is ever live
+        qid = _queue_ids.get((version, src, dst), 0)
+        _queue_ids[(version, src, dst)] = qid + 1
+        return qid
+
+
+def queue_put(dst: int, qid: int, data) -> None:
+    """Append to queue `qid` toward peer `dst` (parity: QueuePut,
+    queue.cpp:47-83). `data` is bytes or a numpy array (sent raw;
+    per-connection FIFO order is the queue order). Wire names carry the
+    cluster version: a message left undrained in a mailbox across an
+    elastic resize can never be popped by the next epoch's queue 0."""
+    p = get_default_peer()
+    sess = p.current_session()
+    payload = data.tobytes() if isinstance(data, np.ndarray) else bytes(data)
+    p.client.send(
+        sess.peers[dst],
+        f"kungfu::queue:v{p.cluster_version}:{sess.rank}:{dst}:{qid}",
+        payload,
+        _ConnType.QUEUE,
+    )
+
+
+def queue_get(src: int, qid: int, timeout: float = 30.0) -> bytes:
+    """Blocking pop from queue `qid` fed by peer `src` (parity: QueueGet)."""
+    p = get_default_peer()
+    sess = p.current_session()
+    return p.queue.get(
+        sess.peers[src],
+        f"kungfu::queue:v{p.cluster_version}:{src}:{sess.rank}:{qid}",
+        timeout,
+    )
 
 
 def save(name: str, data: bytes) -> None:

@@ -195,9 +195,20 @@ class HostSession:
         """Install a runtime forest (e.g. an MST over probed latencies) as
         the active global strategy (parity: SetTree / SetGlobalStrategy,
         adaptation.cpp:5-33). Disables vote-driven switching — an explicit
-        tree wins until the next session epoch."""
+        tree wins until the next session epoch.
+
+        The installed forest must be a single tree rooted at rank 0:
+        gather/reduce/broadcast walk global_strategies[0] assuming its root
+        is rank 0, so a forest rooted elsewhere (or with several roots)
+        would silently produce wrong data. Per-component forests are still
+        available via subset_all_reduce/all_reduce_with."""
         if len(fathers) != self.size:
             raise ValueError(f"forest size {len(fathers)} != cluster {self.size}")
+        roots = [r for r, f in enumerate(fathers) if int(f) == r]
+        if roots != [0]:
+            raise ValueError(
+                f"set_tree forest must be one tree rooted at rank 0, got roots {roots}"
+            )
         self.global_strategies = st.from_forest_array(list(fathers))
         self._tree_override = True
 

@@ -8,6 +8,7 @@ config server at each boundary and every worker resizes via consensus.
 
 from __future__ import annotations
 
+import time
 from typing import List, Optional, Tuple
 
 from kungfu_tpu import api
@@ -55,21 +56,44 @@ class StepBasedSchedule:
     server + consensus like any other elastic event.
     """
 
+    REPROPOSE_AFTER = 10.0  # seconds before a non-landed proposal is resent
+
     def __init__(self, spec: str):
         self.schedule = parse_schedule(spec)
         self._last_proposed: Optional[int] = None
+        self._proposed_at = 0.0
 
     def total_steps(self) -> int:
         return sum(steps for _, steps in self.schedule)
 
     def maybe_propose(self, step: int) -> Optional[int]:
-        """Publish the scheduled size if it changed; returns the size
-        proposed (or None)."""
+        """Publish the scheduled size if the cluster isn't there yet;
+        returns the size proposed (or None).
+
+        _last_proposed is only recorded after propose_new_size SUCCEEDS on
+        the acting rank 0: if the PUT fails or rank 0 detaches at the
+        boundary, the next acting rank 0 re-proposes instead of the
+        schedule silently skipping the resize. A proposal that was accepted
+        but then lost (config-server restart) is also covered: while the
+        observed cluster size stays off-target, the proposal is re-sent
+        every REPROPOSE_AFTER seconds (rate-limited so the steady
+        propose→consensus window doesn't spam the server)."""
         target = schedule_target(self.schedule, step)
-        if target is None or target == self._last_proposed:
+        if target is None:
             return None
+        if target == api.cluster_size():
+            self._last_proposed = target  # landed; don't re-propose
+            return None
+        if api.current_rank() != 0:
+            return None
+        if (
+            target == self._last_proposed
+            and time.monotonic() - self._proposed_at < self.REPROPOSE_AFTER
+        ):
+            # proposed recently: the resize flows through the config-server
+            # consensus in es.end(); give it time to land
+            return None
+        api.propose_new_size(target)
         self._last_proposed = target
-        if api.current_rank() == 0 and target != api.cluster_size():
-            api.propose_new_size(target)
-            return target
-        return None
+        self._proposed_at = time.monotonic()
+        return target

@@ -70,7 +70,6 @@ class Peer:
         self._session: Optional[HostSession] = None
         self._session_lock = threading.RLock()
         self._updated = True
-        self._persisted_tree: Optional[list] = None
         # number of cluster epochs this PROCESS has lived through; 1 after
         # startup, >1 once it survives a delta resize. Lets elastic state
         # sync pick a provably surviving broadcast root.
@@ -147,14 +146,6 @@ class Peer:
                 self.client,
                 self.collective,
             )
-            # persisted set_tree (parity: SetTree, adaptation.cpp:5-33):
-            # reapply across epochs while the rank space is unchanged; a
-            # resize invalidates the father array, so it is dropped then.
-            if self._persisted_tree is not None:
-                if len(self._persisted_tree) == len(peers):
-                    self._session.set_tree(self._persisted_tree)
-                else:
-                    self._persisted_tree = None
             self._peers = peers
             self.epoch_count += 1
         if not self.config.single_process:
@@ -163,10 +154,15 @@ class Peer:
         return True
 
     def set_tree(self, fathers) -> None:
-        """Install + persist a runtime collective forest."""
-        fathers = list(int(f) for f in fathers)
-        self.current_session().set_tree(fathers)
-        self._persisted_tree = fathers
+        """Install a runtime collective tree on the CURRENT session epoch.
+
+        Parity: SetTree (adaptation.cpp:5-33). The father array indexes
+        this epoch's rank space, so it does NOT survive a resize — like the
+        reference, a new session reverts to the configured strategy and the
+        caller re-probes (api.optimized_tree) if it wants a tuned topology.
+        A same-size resize can swap members, so persisting would silently
+        apply an MST probed on different machines (ADVICE r2)."""
+        self.current_session().set_tree(list(int(f) for f in fathers))
 
     # ------------------------------------------------------------------
     # elastic resize protocol (parity: peer.go propose/ResizeCluster*)

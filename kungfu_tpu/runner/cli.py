@@ -38,6 +38,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-H", dest="hosts", default="", help="host list ip:slots[:pub],...")
     p.add_argument("-hostfile", default="", help="hostfile path")
     p.add_argument("-self", dest="self_host", default="", help="this host's address")
+    p.add_argument("-platform", default="",
+                   help="self-discover hosts: tpu-vm | gce | auto "
+                        "(parity: platforms/modelarts)")
     p.add_argument("-strategy", default="AUTO", help=f"one of {[s.name for s in Strategy]}")
     p.add_argument("-port-range", default="38000-38999")
     p.add_argument("-runner-port", type=int, default=DEFAULT_RUNNER_PORT)
@@ -47,6 +50,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="embed a config server on this port (0 = ephemeral)")
     p.add_argument("-elastic-mode", default="", choices=["", "reload"])
     p.add_argument("-auto-recover", default="", help="e.g. 10s: heartbeat auto-recovery")
+    p.add_argument("-debug-port", type=int, default=-1,
+                   help="HTTP endpoint dumping seen Stages (0 = ephemeral)")
     p.add_argument("-logdir", default="")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("-delay", type=float, default=0.0)
@@ -87,7 +92,20 @@ def main(argv: Optional[List[str]] = None) -> int:
         return 2
 
     try:
-        if args.hostfile:
+        if args.platform:
+            from kungfu_tpu.runner.platform import detect
+
+            pc = detect(args.platform)
+            if pc is None:
+                print(f"kfrun: platform {args.platform!r} not detected", file=sys.stderr)
+                return 2
+            import dataclasses as _dc
+
+            slots = max(1, -(-args.np // len(pc.hosts)))  # spread np over hosts
+            hosts = HostList(_dc.replace(h, slots=slots) for h in pc.hosts)
+            if not args.self_host:
+                args.self_host = pc.self_host
+        elif args.hostfile:
             with open(args.hostfile) as f:
                 hosts = parse_hostfile(f.read())
         elif args.hosts:
@@ -117,6 +135,12 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.delay:
         time.sleep(args.delay)
+
+    if args.debug_port >= 0 and not args.watch:
+        print(
+            "kfrun: -debug-port only serves Stage dumps in watch mode (-w); ignoring",
+            file=sys.stderr,
+        )
 
     try:
         if args.auto_recover:

@@ -10,6 +10,7 @@ inconsistent duplicates abort (handler.go:90-103 safety check).
 
 from __future__ import annotations
 
+import collections
 import json
 import queue
 import sys
@@ -44,6 +45,35 @@ class Stage:
         return self.cluster.digest() + str(self.version).encode()
 
 
+class DebugServer:
+    """HTTP endpoint dumping the Stages this runner has seen (parity:
+    -debug-port, runner/handler.go:118-124)."""
+
+    def __init__(self, watcher: "Watcher", port: int):
+        from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):
+                pass
+
+            def do_GET(inner):
+                body = json.dumps(watcher.debug_dump(), indent=2).encode()
+                inner.send_response(200)
+                inner.send_header("Content-Type", "application/json")
+                inner.send_header("Content-Length", str(len(body)))
+                inner.end_headers()
+                inner.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
+        self.port = self.httpd.server_address[1]
+
+    def start(self):
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self):
+        self.httpd.shutdown()
+
+
 class Watcher:
     def __init__(self, args, cmd, self_host: str, strategy, config_server_url: str):
         self.args = args
@@ -54,9 +84,36 @@ class Watcher:
         self.stage_q: "queue.Queue[Stage]" = queue.Queue()
         self.current: Dict[PeerID, WorkerProc] = {}
         self.seen_versions: Dict[int, bytes] = {}
+        # [-debug-port] one entry per Stage; bounded so a long elastic run
+        # without a debug reader doesn't grow a buffer forever
+        self.stage_log: "deque" = collections.deque(maxlen=512)
         self.done = threading.Event()
         self.exit_code = 0
         self._gone: List[WorkerProc] = []
+
+    def debug_dump(self) -> dict:
+        # runs on HTTP handler threads: snapshot mutable state first so a
+        # concurrent apply_delta can't change dict size mid-iteration
+        workers = dict(self.current)
+        return {
+            "self": self.self_host,
+            "stages": list(self.stage_log),
+            "workers": {
+                str(w): ("running" if p.running else f"exit:{p.proc.returncode}")
+                for w, p in workers.items()
+            },
+        }
+
+    def record_stage(self, stage: Stage) -> None:
+        self.stage_log.append(
+            {
+                "version": stage.version,
+                "progress": stage.progress,
+                "reload": stage.reload,
+                "workers": [str(w) for w in stage.cluster.workers],
+                "digest": stage.digest().hex(),
+            }
+        )
 
     # -- control endpoint ----------------------------------------------
     def handle_control(self, src: PeerID, msg: Message) -> None:
@@ -78,6 +135,7 @@ class Watcher:
                 self.done.set()
             return
         self.seen_versions[stage.version] = digest
+        self.record_stage(stage)
         self.stage_q.put(stage)
 
     # -- proc management -----------------------------------------------
@@ -113,6 +171,11 @@ class Watcher:
         server = Server(PeerID(self.self_host, self.args.runner_port), use_unix=False)
         server.register(ConnType.CONTROL, self.handle_control)
         server.start()
+        debug = None
+        if getattr(self.args, "debug_port", -1) >= 0:
+            debug = DebugServer(self, self.args.debug_port)
+            debug.start()
+            print(f"kfrun: debug endpoint on :{debug.port}", file=sys.stderr)
         idle_since: Optional[float] = None
         try:
             self.apply_delta(initial)
@@ -151,10 +214,13 @@ class Watcher:
             for p in self._gone:
                 p.kill()
             server.stop()
+            if debug is not None:
+                debug.stop()
 
 
 def watch_run(args, cmd, cluster: Cluster, self_host: str, strategy, config_server_url: str) -> int:
     watcher = Watcher(args, cmd, self_host, strategy, config_server_url)
     initial = Stage(version=0, progress=0, cluster=cluster)
     watcher.seen_versions[0] = initial.digest()
+    watcher.record_stage(initial)
     return watcher.run(initial)

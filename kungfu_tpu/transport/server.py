@@ -64,13 +64,17 @@ class Server:
         # teardown).
         import time as _time
 
+        import errno as _errno
+
         deadline = _time.monotonic() + bind_timeout
         while True:
             try:
                 tcp.bind(("0.0.0.0", self.self_id.port))
                 break
-            except OSError:
-                if _time.monotonic() >= deadline:
+            except OSError as e:
+                # only the respawn race is transient; EACCES/EADDRNOTAVAIL
+                # and friends are real misconfigurations — surface them now
+                if e.errno != _errno.EADDRINUSE or _time.monotonic() >= deadline:
                     raise
                 _time.sleep(0.25)
         tcp.listen(128)

@@ -54,6 +54,27 @@ def main() -> int:
 
     api.run_barrier()
 
+    # queue api (parity: queue.cpp QueuePut/QueueGet): ring exchange with
+    # FIFO ordering over one queue id per direction
+    if size > 1:
+        nxt, prv = (rank + 1) % size, (rank - 1) % size
+        qid = api.new_queue(rank, nxt)  # both ends count per-pair from 0
+        assert qid == 0 and api.new_queue(rank, nxt) == 1
+        api.queue_put(nxt, qid, b"first:%d" % rank)
+        api.queue_put(nxt, qid, np.array([rank, rank + 1], np.int32))
+        assert api.queue_get(prv, qid) == b"first:%d" % prv  # FIFO order
+        arr = np.frombuffer(api.queue_get(prv, qid), np.int32)
+        assert arr.tolist() == [prv, prv + 1]
+        api.run_barrier()
+
+    # get_neighbour: always a valid peer, never self (incl. non-power-of-2)
+    if size > 1:
+        for step in range(8):
+            nb = api.get_neighbour(step)
+            assert 0 <= nb < size and nb != rank, (step, nb, rank, size)
+            rr = api.round_robin_peer(step)
+            assert 0 <= rr < size and rr != rank
+
     # monitoring e2e (parity: kungfu-test-monitor, ci.yaml:36-41): with
     # KF_CONFIG_ENABLE_MONITORING the transport must have counted real bytes
     # and the /metrics endpoint must serve them.

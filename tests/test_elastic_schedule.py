@@ -101,3 +101,62 @@ def test_schedule_driven_elastic_training_converges():
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     finished = [l for l in r.stdout.splitlines() if "reason=finished" in l]
     assert len(finished) == 2, r.stdout  # final size per the schedule
+
+
+class TestMaybeProposeRetry:
+    """A lost proposal must be retried by the (acting) rank 0 instead of
+    the schedule silently skipping the resize (ADVICE r2)."""
+
+    def _patch(self, monkeypatch, rank, size, fail_once=False):
+        import kungfu_tpu.elastic.schedule as sched_mod
+
+        calls = []
+        state = {"fail": fail_once}
+
+        def propose(n):
+            if state["fail"]:
+                state["fail"] = False
+                raise ConnectionError("config server blip")
+            calls.append(n)
+
+        monkeypatch.setattr(sched_mod.api, "current_rank", lambda: rank)
+        monkeypatch.setattr(sched_mod.api, "cluster_size", lambda: size)
+        monkeypatch.setattr(sched_mod.api, "propose_new_size", propose)
+        return calls
+
+    def test_failed_propose_is_retried(self, monkeypatch):
+        from kungfu_tpu.elastic.schedule import StepBasedSchedule
+
+        calls = self._patch(monkeypatch, rank=0, size=2, fail_once=True)
+        s = StepBasedSchedule("4:10")
+        with pytest.raises(ConnectionError):
+            s.maybe_propose(0)  # PUT fails -> _last_proposed NOT recorded
+        assert s.maybe_propose(1) == 4  # retried
+        assert calls == [4]
+        assert s.maybe_propose(2) is None  # proposed, awaiting consensus
+
+    def test_new_acting_rank0_reproposes(self, monkeypatch):
+        """If the proposing rank 0 detaches, the next acting rank 0 (a
+        different process whose _last_proposed was never set) proposes."""
+        from kungfu_tpu.elastic.schedule import StepBasedSchedule
+
+        calls = self._patch(monkeypatch, rank=1, size=2)
+        s = StepBasedSchedule("4:10")
+        assert s.maybe_propose(0) is None  # not rank 0: never proposes
+        assert calls == []
+        # … original rank 0 died; this peer becomes rank 0
+        import kungfu_tpu.elastic.schedule as sched_mod
+
+        monkeypatch.setattr(sched_mod.api, "current_rank", lambda: 0)
+        assert s.maybe_propose(1) == 4
+        assert calls == [4]
+
+    def test_satisfied_target_not_proposed(self, monkeypatch):
+        from kungfu_tpu.elastic.schedule import StepBasedSchedule
+
+        calls = self._patch(monkeypatch, rank=0, size=4)
+        s = StepBasedSchedule("4:10,2:5")
+        assert s.maybe_propose(0) is None  # already at 4
+        assert calls == []
+        assert s.maybe_propose(10) == 2  # next boundary proposes
+        assert calls == [2]

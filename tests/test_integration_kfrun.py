@@ -71,3 +71,56 @@ def test_kfrun_propagates_worker_failure():
         env=env, capture_output=True, text=True, timeout=60, cwd=REPO,
     )
     assert r.returncode == 1
+
+
+def test_kfrun_debug_port_dumps_stages():
+    """Parity: -debug-port (runner/handler.go:118-124) — the runner serves
+    a JSON dump of the Stages it has seen."""
+    import json
+    import re
+    import time
+    import urllib.request
+
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    p = subprocess.Popen(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2", "-w", "-debug-port", "0", "-q",
+            "-runner-port", "38085",  # private port: don't race other tests
+            "--", sys.executable, "-c", "import time; time.sleep(8)",
+        ],
+        env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE, text=True,
+        cwd=REPO,
+    )
+    try:
+        port = None
+        seen = []
+        deadline = time.monotonic() + 30
+        while time.monotonic() < deadline:
+            line = p.stderr.readline()
+            if not line:
+                if p.poll() is not None:
+                    break
+                time.sleep(0.1)
+                continue
+            seen.append(line)
+            m = re.search(r"debug endpoint on :(\d+)", line)
+            if m:
+                port = int(m.group(1))
+                break
+        assert port, f"no debug endpoint line; stderr so far:\n{''.join(seen)}"
+        # the endpoint comes up before the watcher spawns workers: poll
+        dump = None
+        while time.monotonic() < deadline:
+            with urllib.request.urlopen(f"http://127.0.0.1:{port}/", timeout=5) as r:
+                dump = json.loads(r.read().decode())
+            if len(dump["workers"]) == 2:
+                break
+            time.sleep(0.2)
+        assert dump and dump["stages"] and dump["stages"][0]["version"] == 0
+        assert len(dump["stages"][0]["workers"]) == 2
+        assert len(dump["workers"]) == 2, dump
+    finally:
+        p.kill()
+        p.wait(10)

@@ -1,0 +1,72 @@
+"""Elastic state-sync wire format: dtype-preserving leaf serialization.
+
+The joiner re-sync broadcast (ElasticState._sync_state) must round-trip
+every dtype a TPU training state contains — bf16 params, fp8 scales,
+integer step counters — not just fp32 (ADVICE r2: np.savez stored
+ml_dtypes leaves as void arrays that could not be cast back).
+"""
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.elastic.state import _pack_leaves, _unpack_leaves
+
+
+def _roundtrip(leaves):
+    blob = _pack_leaves(leaves)
+    out = _unpack_leaves(blob, len(leaves))
+    assert len(out) == len(leaves)
+    for got, want in zip(out, leaves):
+        want = np.asarray(want)
+        assert got.dtype == want.dtype, (got.dtype, want.dtype)
+        assert got.shape == want.shape
+        assert got.tobytes() == np.ascontiguousarray(want).tobytes()
+    return out
+
+
+def test_fp32_roundtrip():
+    _roundtrip([np.arange(12, dtype=np.float32).reshape(3, 4)])
+
+
+def test_bfloat16_roundtrip():
+    import ml_dtypes
+
+    x = np.arange(8, dtype=np.float32).astype(ml_dtypes.bfloat16)
+    _roundtrip([x])
+
+
+def test_fp8_roundtrip():
+    import ml_dtypes
+
+    x = np.linspace(-2, 2, 16, dtype=np.float32).astype(ml_dtypes.float8_e4m3fn)
+    _roundtrip([x])
+
+
+def test_mixed_tree_roundtrip():
+    import ml_dtypes
+
+    leaves = [
+        np.arange(6, dtype=np.float32).reshape(2, 3),
+        np.ones((4,), ml_dtypes.bfloat16),
+        np.array(7, np.int64),  # optimizer step counter (0-d)
+        np.zeros((0, 5), np.float32),  # empty leaf
+        np.array([True, False]),
+    ]
+    _roundtrip(leaves)
+
+
+def test_jax_bf16_arrays_roundtrip():
+    """Leaves straight from a jitted bf16 train state."""
+    import jax.numpy as jnp
+
+    x = jnp.asarray(np.arange(10), jnp.bfloat16) * 1.5
+    (got,) = _roundtrip([np.asarray(x)])
+    np.testing.assert_array_equal(
+        np.asarray(got, np.float32), np.asarray(x, np.float32)
+    )
+
+
+def test_leaf_count_mismatch_rejected():
+    blob = _pack_leaves([np.zeros(3, np.float32)])
+    with pytest.raises(ValueError):
+        _unpack_leaves(blob, 2)

@@ -110,3 +110,21 @@ def test_digest_stable():
     b = st.digest(st.gen_global_strategies(peers, Strategy.RING))
     c = st.digest(st.gen_global_strategies(peers, Strategy.STAR))
     assert a == b and a != c
+
+
+def test_set_tree_requires_rank0_rooted_tree():
+    """gather/reduce/broadcast assume global_strategies[0] is rooted at
+    rank 0, so set_tree must reject forests rooted elsewhere or with
+    several roots (ADVICE r2)."""
+    from kungfu_tpu.collective.host_session import HostSession
+
+    peers = make_peers(("a", 3))
+    sess = HostSession(Strategy.STAR, peers[0], peers, client=None, endpoint=None)
+    sess.set_tree([0, 0, 0])  # valid: one tree rooted at 0
+    assert sess.active_strategy() is None  # override active
+    with pytest.raises(ValueError):
+        sess.set_tree([1, 1, 1])  # rooted at rank 1
+    with pytest.raises(ValueError):
+        sess.set_tree([0, 1, 1])  # two roots (forest)
+    with pytest.raises(ValueError):
+        sess.set_tree([0, 0])  # wrong size

@@ -167,3 +167,25 @@ def test_versioned_store_gc_window():
     assert vs.latest_version("m") == 4
     assert vs.get_latest("m") == b"4"
     assert vs.latest_version("other") is None
+
+
+def test_bind_fails_fast_on_non_transient_error(monkeypatch):
+    """Only EADDRINUSE (the elastic respawn race) is retried; real
+    misconfigurations like EACCES surface immediately instead of after a
+    15 s retry window (ADVICE r2)."""
+    import errno
+    import socket
+    import time
+
+    from kungfu_tpu.transport.server import Server
+
+    def bad_bind(self, addr):
+        raise OSError(errno.EACCES, "permission denied")
+
+    monkeypatch.setattr(socket.socket, "bind", bad_bind)
+    srv = Server(PeerID("127.0.0.1", 39990), use_unix=False)
+    t0 = time.monotonic()
+    with pytest.raises(OSError) as ei:
+        srv.start(bind_timeout=15.0)
+    assert ei.value.errno == errno.EACCES
+    assert time.monotonic() - t0 < 2.0  # no retry loop
