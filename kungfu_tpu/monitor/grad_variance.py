@@ -66,15 +66,20 @@ def monitor_gradient_variance(
 
     def update(grads, state, params=None, **extra):
         avg = jax.tree.map(lambda g: lax.pmean(g, axis_name), grads)
-        do_update = (
-            jnp.ones((), bool) if interval == 1
-            else jnp.mod(state.grad_var.count, interval) == 0
-        )
-        est = _variance_estimate(grads, avg, axis_name)
-        gv = GradVarState(
-            variance=jnp.where(do_update, est, state.grad_var.variance),
-            count=state.grad_var.count + 1,
-        )
+        if interval == 1:
+            est = _variance_estimate(grads, avg, axis_name)
+        else:
+            # lax.cond (not where): the estimate costs a second
+            # gradient-sized cross-worker pmean per leaf, so off-interval
+            # steps must SKIP the collectives, not discard their result.
+            # The predicate is replicated (derived from the replicated
+            # count), so every worker takes the same branch.
+            est = lax.cond(
+                jnp.mod(state.grad_var.count, interval) == 0,
+                lambda: _variance_estimate(grads, avg, axis_name),
+                lambda: state.grad_var.variance,
+            )
+        gv = GradVarState(variance=est, count=state.grad_var.count + 1)
         updates, base_state = base.update(avg, state.base, params, **extra)
         return updates, _MonitorState(base=base_state, grad_var=gv)
 

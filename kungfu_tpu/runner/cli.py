@@ -50,6 +50,14 @@ def build_parser() -> argparse.ArgumentParser:
                    help="embed a config server on this port (0 = ephemeral)")
     p.add_argument("-elastic-mode", default="", choices=["", "reload"])
     p.add_argument("-auto-recover", default="", help="e.g. 10s: heartbeat auto-recovery")
+    p.add_argument("-monitor-port", type=int, default=7756,
+                   help="heartbeat monitor port (0 = ephemeral)")
+    p.add_argument("-monitor-peers", default="",
+                   help="all runners' monitor host:port list (default: "
+                        "every runner host on -monitor-port)")
+    p.add_argument("-devices-per-host", type=int, default=0,
+                   help="partition this many chip ids among local workers "
+                        "(TPU_VISIBLE_DEVICES pinning; 0 = no pinning)")
     p.add_argument("-debug-port", type=int, default=-1,
                    help="HTTP endpoint dumping seen Stages (0 = ephemeral)")
     p.add_argument("-logdir", default="")
@@ -120,6 +128,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         cluster.validate()
         self_host = args.self_host or infer_self_host(hosts)
         strategy = Strategy.parse(args.strategy)
+        # device-slot share is sized by host CAPACITY, stable across resizes
+        args.host_capacity = next(
+            (h.slots for h in hosts if h.host == self_host), 1
+        )
+        if 0 < args.devices_per_host < args.host_capacity:
+            # at full capacity every local worker needs >= 1 chip, or a
+            # later elastic grow would exhaust the watcher's slot pool
+            raise ValueError(
+                f"-devices-per-host {args.devices_per_host} < host capacity "
+                f"{args.host_capacity}: not every worker could get a chip"
+            )
     except (ValueError, OSError) as e:
         print(f"kfrun: {e}", file=sys.stderr)
         return 2
@@ -160,7 +179,7 @@ def main(argv: Optional[List[str]] = None) -> int:
 def make_one_worker_proc(
     args, cmd, cluster: Cluster, worker: PeerID, self_host: str,
     strategy: Strategy, config_server_url: str = "", version: int = 0,
-    progress: int = 0,
+    progress: int = 0, device_slots=None,
 ) -> WorkerProc:
     rank = cluster.workers.rank(worker)
     env = kfenv.worker_env(
@@ -173,6 +192,7 @@ def make_one_worker_proc(
         config_server=config_server_url,
         elastic_mode=args.elastic_mode,
         init_progress=progress,
+        device_slots=device_slots,
     )
     env["KF_LOG_PREFIX"] = f"{rank}/{len(cluster.workers)}"
     return WorkerProc(
@@ -189,13 +209,24 @@ def make_worker_procs(
     args, cmd, cluster: Cluster, self_host: str, strategy: Strategy,
     config_server_url: str = "", version: int = 0, progress: int = 0,
 ) -> List[WorkerProc]:
+    local = [w for w in cluster.workers if w.host == self_host]
+    slot_parts: List[Optional[list]] = [None] * len(local)
+    n_dev = getattr(args, "devices_per_host", 0)
+    if n_dev > 0 and local:
+        from kungfu_tpu.runner.slots import partition
+
+        if len(local) > n_dev:
+            raise SystemExit(
+                f"kfrun: {len(local)} local workers but only {n_dev} device slots"
+            )
+        # static membership (simple/monitored runs): rank-major stripes
+        slot_parts = partition(n_dev, len(local))
     return [
         make_one_worker_proc(
             args, cmd, cluster, w, self_host, strategy, config_server_url,
-            version, progress,
+            version, progress, device_slots=slot_parts[i],
         )
-        for w in cluster.workers
-        if w.host == self_host
+        for i, w in enumerate(local)
     ]
 
 

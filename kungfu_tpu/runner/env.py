@@ -23,6 +23,7 @@ ALLREDUCE_STRATEGY = "KF_ALLREDUCE_STRATEGY"
 CONFIG_SERVER = "KF_CONFIG_SERVER"
 ELASTIC_MODE = "KF_ELASTIC_MODE"
 INIT_PROGRESS = "KF_INIT_PROGRESS"
+DEVICE_SLOTS = "KF_DEVICE_SLOTS"
 # tuning (parity: config/config.go:24-67)
 ENABLE_MONITORING = "KF_CONFIG_ENABLE_MONITORING"
 ENABLE_STALL_DETECTION = "KF_CONFIG_ENABLE_STALL_DETECTION"
@@ -31,7 +32,7 @@ LOG_LEVEL = "KF_CONFIG_LOG_LEVEL"
 ALL_ENV_NAMES = [
     SELF_SPEC, INIT_PEERS, INIT_RUNNERS, PARENT_ID, INIT_CLUSTER_VERSION,
     ALLREDUCE_STRATEGY, CONFIG_SERVER, ELASTIC_MODE, INIT_PROGRESS,
-    ENABLE_MONITORING, ENABLE_STALL_DETECTION, LOG_LEVEL,
+    DEVICE_SLOTS, ENABLE_MONITORING, ENABLE_STALL_DETECTION, LOG_LEVEL,
 ]
 
 
@@ -47,6 +48,9 @@ class WorkerConfig:
     elastic_mode: str  # "" (delta) | "reload"
     init_progress: int
     single_process: bool = False
+    # chip ids this worker may open (empty = unrestricted); parity:
+    # job/gpu_resource.go slot assignment via CUDA_VISIBLE_DEVICES
+    device_slots: tuple = ()
 
 
 def parse_config_from_env(environ=None) -> WorkerConfig:
@@ -67,6 +71,7 @@ def parse_config_from_env(environ=None) -> WorkerConfig:
             init_progress=int(env.get(INIT_PROGRESS, "0") or 0),
             single_process=True,
         )
+    slots_raw = env.get(DEVICE_SLOTS, "")
     return WorkerConfig(
         self_id=PeerID.parse(self_spec),
         peers=PeerList.parse(env.get(INIT_PEERS, self_spec)),
@@ -77,6 +82,7 @@ def parse_config_from_env(environ=None) -> WorkerConfig:
         config_server=env.get(CONFIG_SERVER, ""),
         elastic_mode=env.get(ELASTIC_MODE, ""),
         init_progress=int(env.get(INIT_PROGRESS, "0") or 0),
+        device_slots=tuple(int(s) for s in slots_raw.split(",") if s.strip()),
     )
 
 
@@ -90,6 +96,7 @@ def worker_env(
     config_server: str = "",
     elastic_mode: str = "",
     init_progress: int = 0,
+    device_slots=None,
 ) -> dict:
     """Env block a runner sets for a spawned worker (parity: job.go:35-80)."""
     env = {
@@ -105,4 +112,10 @@ def worker_env(
         env[CONFIG_SERVER] = config_server
     if elastic_mode:
         env[ELASTIC_MODE] = elastic_mode
+    if device_slots:
+        ids = ",".join(str(i) for i in device_slots)
+        env[DEVICE_SLOTS] = ids
+        # the TPU analog of CUDA_VISIBLE_DEVICES (job.go:35-80): libtpu
+        # initializes only these chips in each worker process
+        env["TPU_VISIBLE_DEVICES"] = ids
     return env

@@ -7,18 +7,39 @@ a worker that stays inside a batch longer than the grace period is declared
 stuck, all workers are killed and relaunched with ``--restart 1`` appended
 so the training script reloads its checkpoint and continues from the last
 completed epoch.
+
+Cross-host protocol (parity: monitor.go:103-140): when any host's monitor
+detects a LOCAL stuck worker it broadcasts ``otherdown:<minEpoch>`` to
+every other runner's monitor, so hosts whose own workers look merely idle
+(blocked in a collective without an outstanding batch) restart in lockstep
+instead of waiting out their own grace period. The reference only lets the
+MAIN (first) host broadcast; here any detecting host does — a hang on a
+non-main host still converges, just via the main host's own detection, but
+broadcasting from the detector is strictly faster.
+
+Worker contract:
+- KF_MONITOR_ADDR (set by the runner): where send_heartbeat POSTs.
+- On relaunch the runner appends ``--restart 1`` (once) to the command and
+  sets KF_RECOVER_EPOCH=<min completed epoch> so scripts without their own
+  checkpoint bookkeeping know where to resume (the reference edits the
+  script's --n-epochs flag instead; an env var doesn't assume a flag
+  naming convention).
 """
 
 from __future__ import annotations
 
+import os
 import sys
 import threading
 import time
+import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, Optional
+from typing import Dict, List, Optional, Tuple
 
 MONITOR_PORT = 7756
 DEFAULT_GRACE = 10.0
+MONITOR_ADDR_ENV = "KF_MONITOR_ADDR"
+RECOVER_EPOCH_ENV = "KF_RECOVER_EPOCH"
 
 
 class HeartbeatState:
@@ -27,6 +48,8 @@ class HeartbeatState:
         self.in_batch: Dict[int, float] = {}  # rank -> batch begin time
         self.epochs: Dict[int, int] = {}
         self.train_ended: Dict[int, bool] = {}
+        self.other_down: Optional[int] = None  # min epoch from a remote host
+        self.other_finish = False
 
     def signal(self, kind: str, rank: int) -> None:
         now = time.monotonic()
@@ -40,6 +63,10 @@ class HeartbeatState:
             elif kind == "trainend":
                 self.train_ended[rank] = True
                 self.in_batch.pop(rank, None)
+            elif kind == "otherdown":
+                self.other_down = rank  # value is the min epoch, not a rank
+            elif kind == "otherfinish":
+                self.other_finish = True
 
     def stuck_ranks(self, grace: float):
         now = time.monotonic()
@@ -58,10 +85,12 @@ class HeartbeatState:
         with self._lock:
             self.in_batch.clear()
             self.train_ended.clear()
+            self.other_down = None
 
 
 class MonitorServer:
-    """HTTP endpoint workers POST heartbeats to (parity: :7756 server)."""
+    """HTTP endpoint for worker heartbeats and peer-monitor control
+    messages (parity: the :7756 server)."""
 
     def __init__(self, state: HeartbeatState, port: int = MONITOR_PORT):
         self.state = state
@@ -73,9 +102,9 @@ class MonitorServer:
             def do_POST(inner):
                 n = int(inner.headers.get("Content-Length", 0))
                 body = inner.rfile.read(n).decode().strip()
-                kind, _, rank = body.partition(":")
+                kind, _, value = body.partition(":")
                 try:
-                    self.state.signal(kind, int(rank))
+                    self.state.signal(kind, int(value))
                     inner.send_response(200)
                 except ValueError:
                     inner.send_response(400)
@@ -102,45 +131,126 @@ def parse_duration(s: str) -> float:
     return float(s)
 
 
+def _post(addr: str, body: str, timeout: float = 3.0) -> bool:
+    req = urllib.request.Request(
+        f"http://{addr}/signal", data=body.encode(), method="POST"
+    )
+    try:
+        with urllib.request.urlopen(req, timeout=timeout) as resp:
+            resp.read()
+        return True
+    except OSError:
+        return False
+
+
+def _monitor_peers(args, cluster, self_host: str) -> List[str]:
+    """Other runners' monitor addresses. -monitor-peers overrides (needed
+    for multi-runner localhost tests where ports must differ); default =
+    every other runner host on this runner's monitor port."""
+    spec = getattr(args, "monitor_peers", "") or ""
+    if spec:
+        peers = [p.strip() for p in spec.split(",") if p.strip()]
+        # exclude self (match on host:port)
+        me = f"{self_host}:{getattr(args, 'monitor_port', MONITOR_PORT)}"
+        return [p for p in peers if p != me]
+    port = getattr(args, "monitor_port", MONITOR_PORT) or MONITOR_PORT
+    return [
+        f"{r.host}:{port}" for r in cluster.runners if r.host != self_host
+    ]
+
+
 def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
     """Launch-and-relaunch loop (parity: MonitoredRun, monitored.go:18-75)."""
     from kungfu_tpu.runner.cli import make_worker_procs
 
+    import subprocess
+
     grace = parse_duration(args.auto_recover) if args.auto_recover else DEFAULT_GRACE
     state = HeartbeatState()
-    monitor = MonitorServer(state, MONITOR_PORT)
+    monitor_port = getattr(args, "monitor_port", MONITOR_PORT)
+    monitor = MonitorServer(state, monitor_port)
     monitor.start()
+    peers = _monitor_peers(args, cluster, self_host)
     n_local = sum(1 for w in cluster.workers if w.host == self_host)
     restart = 0
+    recover_epoch = 0  # min completed epoch across local + otherdown info
     try:
         while True:
             worker_cmd = list(cmd)
             if restart > 0:
                 worker_cmd += ["--restart", "1"]
             procs = make_worker_procs(args, worker_cmd, cluster, self_host, strategy)
+            state.reset()  # before spawn: a begin must never race the wipe
             for p in procs:
+                p.env[MONITOR_ADDR_ENV] = f"{self_host}:{monitor.port}"
+                if restart > 0:
+                    p.env[RECOVER_EPOCH_ENV] = str(recover_epoch)
                 p.start()
-            state.reset()
             failed = False
+            local_down = False
             while True:
                 if all(not p.running for p in procs):
                     codes = [p.proc.returncode for p in procs]
                     if all(c == 0 for c in codes):
                         return 0
                     failed = True
+                    print(
+                        f"kfrun: workers exited {codes}; restarting",
+                        file=sys.stderr,
+                    )
+                    recover_epoch = state.min_epoch()
                     break
                 if state.stuck_ranks(grace):
+                    recover_epoch = state.min_epoch()
                     print(
-                        f"kfrun: worker stuck > {grace}s at epoch {state.min_epoch()}; restarting",
+                        f"kfrun: worker stuck > {grace}s at epoch {recover_epoch}; restarting",
+                        file=sys.stderr,
+                    )
+                    failed = True
+                    local_down = True
+                    break
+                if state.other_down is not None:
+                    # the broadcast carries the DETECTING host's min epoch:
+                    # every host must resume from the cluster-wide min, not
+                    # its own (a fast host would otherwise skip ahead)
+                    recover_epoch = min(state.min_epoch(), state.other_down)
+                    print(
+                        f"kfrun: otherdown:{state.other_down} received; restarting",
                         file=sys.stderr,
                     )
                     failed = True
                     break
-                if state.all_done(n_local):
+                if state.all_done(n_local) or state.other_finish:
+                    # trainend heartbeats (or a remote all-finish) arrived:
+                    # let local procs run to completion and judge by their
+                    # exit codes — never report success over a failure
+                    codes = []
                     for p in procs:
-                        p.wait(30)
-                    return 0
-                time.sleep(0.5)
+                        try:
+                            codes.append(p.wait(600))
+                        except subprocess.TimeoutExpired:
+                            p.kill()
+                            codes.append(-1)
+                    if peers and state.all_done(n_local):
+                        for addr in peers:
+                            _post(addr, "otherfinish:0")
+                    if all(c == 0 for c in codes):
+                        return 0
+                    failed = True
+                    recover_epoch = state.min_epoch()
+                    print(
+                        f"kfrun: workers exited {codes} after trainend; restarting",
+                        file=sys.stderr,
+                    )
+                    break
+                time.sleep(0.25)
+            if local_down and peers:
+                # tell the other hosts before tearing down locally so the
+                # whole cluster restarts in lockstep (parity: otherdown
+                # broadcast, monitor.go:103-140)
+                body = f"otherdown:{recover_epoch}"
+                for addr in peers:
+                    _post(addr, body)
             for p in procs:
                 p.kill()
             if not failed:
@@ -153,15 +263,18 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
         monitor.stop()
 
 
-def send_heartbeat(kind: str, rank: int, host: str = "127.0.0.1", port: int = MONITOR_PORT) -> None:
-    """Worker-side heartbeat (parity: kungfu.cmd.monitor_batch_begin etc.)."""
-    import urllib.request
+def send_heartbeat(
+    kind: str, rank: int, host: str = "", port: int = 0
+) -> None:
+    """Worker-side heartbeat (parity: kungfu.cmd.monitor_batch_begin etc.).
 
-    req = urllib.request.Request(
-        f"http://{host}:{port}/signal", data=f"{kind}:{rank}".encode(), method="POST"
-    )
-    try:
-        with urllib.request.urlopen(req, timeout=2) as resp:
-            resp.read()
-    except OSError:
-        pass  # monitor absent: heartbeats are best-effort
+    Address resolution: explicit host and/or port args (a bare port targets
+    localhost), else KF_MONITOR_ADDR (set by the monitored runner), else
+    localhost:7756. Best-effort: a missing monitor is not an error (scripts
+    run unchanged without -auto-recover).
+    """
+    if host or port:
+        addr = f"{host or '127.0.0.1'}:{port or MONITOR_PORT}"
+    else:
+        addr = os.environ.get(MONITOR_ADDR_ENV, "") or f"127.0.0.1:{MONITOR_PORT}"
+    _post(addr, f"{kind}:{rank}", timeout=2.0)

@@ -90,14 +90,34 @@ class Watcher:
         self.done = threading.Event()
         self.exit_code = 0
         self._gone: List[WorkerProc] = []
+        # guards current/stage_log: mutated on the watcher + control
+        # threads, read by -debug-port HTTP handler threads
+        self._state_lock = threading.Lock()
+        # device-slot pool (parity: job/gpu_resource.go): joiners draw from
+        # it, leavers return to it, so workers sharing this host never open
+        # the same chips across resizes. Share size is fixed by the host's
+        # slot CAPACITY (not current np) so surviving workers — whose env
+        # cannot change — keep valid stripes as the cluster grows.
+        self.slot_pool = None
+        self.chips_per_worker = 0
+        self._worker_slots: Dict[PeerID, list] = {}
+        n_dev = getattr(args, "devices_per_host", 0)
+        if n_dev > 0:
+            from kungfu_tpu.runner.slots import SlotPool
+
+            cap = max(1, getattr(args, "host_capacity", 0))
+            self.chips_per_worker = max(1, n_dev // cap)
+            self.slot_pool = SlotPool.of_size(n_dev)
 
     def debug_dump(self) -> dict:
-        # runs on HTTP handler threads: snapshot mutable state first so a
-        # concurrent apply_delta can't change dict size mid-iteration
-        workers = dict(self.current)
+        # runs on HTTP handler threads: snapshot under the state lock so a
+        # concurrent apply_delta/record_stage can't mutate mid-iteration
+        with self._state_lock:
+            workers = dict(self.current)
+            stages = list(self.stage_log)
         return {
             "self": self.self_host,
-            "stages": list(self.stage_log),
+            "stages": stages,
             "workers": {
                 str(w): ("running" if p.running else f"exit:{p.proc.returncode}")
                 for w, p in workers.items()
@@ -105,15 +125,15 @@ class Watcher:
         }
 
     def record_stage(self, stage: Stage) -> None:
-        self.stage_log.append(
-            {
-                "version": stage.version,
-                "progress": stage.progress,
-                "reload": stage.reload,
-                "workers": [str(w) for w in stage.cluster.workers],
-                "digest": stage.digest().hex(),
-            }
-        )
+        entry = {
+            "version": stage.version,
+            "progress": stage.progress,
+            "reload": stage.reload,
+            "workers": [str(w) for w in stage.cluster.workers],
+            "digest": stage.digest().hex(),
+        }
+        with self._state_lock:
+            self.stage_log.append(entry)
 
     # -- control endpoint ----------------------------------------------
     def handle_control(self, src: PeerID, msg: Message) -> None:
@@ -142,27 +162,50 @@ class Watcher:
     def _spawn(self, w: PeerID, stage: Stage) -> None:
         from kungfu_tpu.runner.cli import make_one_worker_proc
 
+        slots = None
+        if self.slot_pool is not None:
+            try:
+                slots = self.slot_pool.get(self.chips_per_worker)
+                self._worker_slots[w] = slots
+            except RuntimeError as e:
+                # a growing host exceeding its chip budget must not crash
+                # the runner mid-resize: spawn unpinned and say so (the
+                # upfront cli check makes this unreachable for valid plans)
+                print(f"kfrun: {e}; spawning {w} unpinned", file=sys.stderr)
+                slots = None
         p = make_one_worker_proc(
             self.args, self.cmd, stage.cluster, w, self.self_host, self.strategy,
             self.config_server_url, version=stage.version, progress=stage.progress,
+            device_slots=slots,
         )
         p.start()
-        self.current[w] = p
+        with self._state_lock:
+            self.current[w] = p
+
+    def _release_slots(self, w: PeerID) -> None:
+        if self.slot_pool is not None and w in self._worker_slots:
+            self.slot_pool.put(self._worker_slots.pop(w))
 
     def apply_delta(self, stage: Stage) -> None:
         new_local = {w for w in stage.cluster.workers if w.host == self.self_host}
-        old_local = set(self.current)
+        with self._state_lock:
+            old_local = set(self.current)
         for w in old_local - new_local:
-            proc = self.current.pop(w)
+            with self._state_lock:
+                proc = self.current.pop(w)
             self._gone.append(proc)  # worker exits itself on detach
+            self._release_slots(w)
         for w in sorted(new_local - old_local):
             self._spawn(w, stage)
 
     def apply_full(self, stage: Stage) -> None:
         """Reload mode: stop everything, restart from stage.progress."""
-        for w, proc in list(self.current.items()):
+        with self._state_lock:
+            doomed = list(self.current.items())
+            self.current.clear()
+        for w, proc in doomed:
             proc.kill()
-        self.current.clear()
+            self._release_slots(w)
         for w in stage.cluster.workers:
             if w.host == self.self_host:
                 self._spawn(w, stage)

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import os
 import socket
+import struct
 import threading
 from typing import Callable, Dict, Optional
 
@@ -29,7 +30,9 @@ Handler = Callable[[PeerID, Message], None]
 
 
 def unix_sock_path(peer: PeerID) -> str:
-    return f"/tmp/kungfu_tpu-{peer.port}.sock"
+    # host-qualified: two loopback aliases (127.0.0.1 / 127.0.0.2) may carry
+    # the same port on one machine (multi-"host" localhost clusters)
+    return f"/tmp/kungfu_tpu-{peer.host}-{peer.port}.sock"
 
 
 class Server:
@@ -58,6 +61,10 @@ class Server:
     def start(self, bind_timeout: float = 15.0) -> None:
         tcp = socket.socket(socket.AF_INET, socket.SOCK_STREAM)
         tcp.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEADDR, 1)
+        # Bind to the ADVERTISED host (peers dial exactly that address), so
+        # multi-"host" localhost clusters can stack the same port on
+        # different loopback aliases; fall back to the wildcard when the
+        # advertised name doesn't resolve to a local interface.
         # Bind retry: after an elastic shrink-then-grow, a respawned worker
         # can race the previous incarnation's exit for the same port (the
         # watcher does not serialize spawn against the detached process's
@@ -69,11 +76,16 @@ class Server:
         deadline = _time.monotonic() + bind_timeout
         while True:
             try:
-                tcp.bind(("0.0.0.0", self.self_id.port))
+                try:
+                    tcp.bind((self.self_id.host, self.self_id.port))
+                except (socket.gaierror, OSError) as e:
+                    if isinstance(e, OSError) and e.errno == _errno.EADDRINUSE:
+                        raise
+                    tcp.bind(("0.0.0.0", self.self_id.port))
                 break
             except OSError as e:
-                # only the respawn race is transient; EACCES/EADDRNOTAVAIL
-                # and friends are real misconfigurations — surface them now
+                # only the respawn race is transient; EACCES and friends
+                # are real misconfigurations — surface them now
                 if e.errno != _errno.EADDRINUSE or _time.monotonic() >= deadline:
                     raise
                 _time.sleep(0.25)
@@ -151,6 +163,10 @@ class Server:
                     monitor.received(src, len(msg.data))
                 handler(src, msg)
         except (ConnectionError, OSError):
+            pass
+        except (ValueError, UnicodeDecodeError, struct.error):
+            # malformed frames (bad enum value / undecodable name / short
+            # struct): a garbage-sending peer must not take the server down
             pass
         finally:
             try:

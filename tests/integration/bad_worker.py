@@ -1,0 +1,111 @@
+"""Fault-injection worker: heartbeat-driven training that misbehaves on cue.
+
+Parity: tests/go/cmd/kungfu-bad-worker (fault injector) + the reference's
+Failure_recovery_examples — a fake trainer that sends begin/end/epoch/
+trainend heartbeats, checkpoints its epoch to disk, and on the FIRST run
+(no --restart flag) injects one fault at --fault-epoch on --fault-rank:
+
+  crash       exit(7) mid-batch
+  hang        sleep forever INSIDE a batch (begin sent, end never sent)
+  hang-quiet  sleep forever BETWEEN batches (own monitor sees nothing; only
+              a peer host's monitor can detect via its blocked worker ->
+              exercises the cross-host otherdown broadcast)
+  garbage     spray malformed bytes at peer transport ports, then continue
+              normally (peers must shrug it off)
+
+On relaunch (--restart 1) it resumes from its checkpoint and finishes.
+Each epoch runs a real host-plane allreduce so a hung peer provably blocks
+the others (their begin stays outstanding -> their monitor detects stuck).
+"""
+
+import argparse
+import os
+import socket
+import sys
+import time
+
+import numpy as np
+
+
+def main() -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--mode", default="none",
+                    choices=["none", "crash", "hang", "hang-quiet", "garbage"])
+    ap.add_argument("--fault-epoch", type=int, default=1)
+    ap.add_argument("--fault-rank", type=int, default=1)
+    ap.add_argument("--epochs", type=int, default=3)
+    ap.add_argument("--ckpt-dir", required=True)
+    ap.add_argument("--restart", type=int, default=0)
+    args = ap.parse_args()
+
+    from kungfu_tpu import api
+    from kungfu_tpu.runner.monitored import send_heartbeat
+
+    rank = api.current_rank()
+    size = api.cluster_size()
+
+    ckpt = os.path.join(args.ckpt_dir, f"rank{rank}.epoch")
+    start_epoch = 0
+    if args.restart:
+        if os.path.exists(ckpt):
+            start_epoch = int(open(ckpt).read().strip() or 0) + 1
+        recover = os.environ.get("KF_RECOVER_EPOCH", "")
+        print(f"restarted from epoch {start_epoch} (KF_RECOVER_EPOCH={recover})")
+
+    inject = (not args.restart) and args.mode != "none" and rank == args.fault_rank
+
+    for epoch in range(start_epoch, args.epochs):
+        if inject and epoch == args.fault_epoch and args.mode == "hang-quiet":
+            print(f"rank {rank}: hanging quietly before epoch {epoch}")
+            sys.stdout.flush()
+            time.sleep(3600)
+
+        send_heartbeat("begin", rank)
+
+        if inject and epoch == args.fault_epoch:
+            if args.mode == "crash":
+                print(f"rank {rank}: crashing at epoch {epoch}")
+                sys.stdout.flush()
+                os._exit(7)
+            if args.mode == "hang":
+                print(f"rank {rank}: hanging in-batch at epoch {epoch}")
+                sys.stdout.flush()
+                time.sleep(3600)
+            if args.mode == "garbage":
+                from kungfu_tpu.peer import get_default_peer
+
+                sess = get_default_peer().current_session()
+                for p in sess.peers:
+                    if p == sess.peers[rank]:
+                        continue
+                    try:
+                        s = socket.create_connection((p.host, p.port), timeout=3)
+                        s.sendall(b"\xde\xad\xbe\xef" * 64)  # bogus header
+                        s.close()
+                        s = socket.create_connection((p.host, p.port), timeout=3)
+                        s.sendall(bytes(range(256)))
+                        s.close()
+                    except OSError:
+                        pass
+                print(f"rank {rank}: sprayed garbage at epoch {epoch}")
+
+        # one real collective per epoch: a hung peer blocks everyone here
+        out = api.all_reduce_array(
+            np.full(64, rank + 1, np.float64), name=f"epoch{epoch}"
+        )
+        assert np.all(out == size * (size + 1) / 2), out[:4]
+
+        send_heartbeat("end", rank)
+        send_heartbeat("epoch", rank)
+        with open(ckpt, "w") as f:
+            f.write(str(epoch))
+        print(f"rank {rank}: epoch {epoch} done")
+        sys.stdout.flush()
+
+    send_heartbeat("trainend", rank)
+    print(f"rank {rank}: training complete ({args.epochs} epochs)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,141 @@
+"""Failure auto-recovery e2e: heartbeats, stuck detection, kill+relaunch,
+cross-host otherdown, and fault injection.
+
+Parity: -auto-recover (runner/monitorserver/monitor.go:103-140 +
+runner/monitored.go:18-75) and tests/go/cmd/kungfu-bad-worker. Each test
+runs a REAL kfrun cluster whose injected fault (hang / crash / quiet hang /
+garbage frames) must be detected and survived: workers are relaunched with
+--restart 1 + KF_RECOVER_EPOCH and training completes from checkpoints.
+"""
+
+import os
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+BAD_WORKER = os.path.join(REPO, "tests", "integration", "bad_worker.py")
+
+
+def run_recover(tmp_path, mode, np_=2, extra=(), timeout=120, monitor_port="0"):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", str(np_),
+            "-auto-recover", "3s",
+            "-monitor-port", monitor_port,
+            *extra,
+            "--", sys.executable, BAD_WORKER,
+            "--mode", mode, "--ckpt-dir", str(tmp_path), "--epochs", "3",
+        ],
+        env=env, capture_output=True, text=True, timeout=timeout, cwd=REPO,
+    )
+
+
+def assert_recovered(r, tmp_path, np_=2):
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "restarting" in r.stderr, r.stderr
+    assert "restarted from epoch" in r.stdout, r.stdout
+    done = [l for l in r.stdout.splitlines() if "training complete" in l]
+    assert len(done) == np_, r.stdout
+    for rank in range(np_):
+        ckpt = tmp_path / f"rank{rank}.epoch"
+        assert int(ckpt.read_text()) == 2, f"rank {rank} final epoch"
+
+
+def test_auto_recover_from_in_batch_hang(tmp_path):
+    """A worker hangs mid-batch: its own begin-without-end trips the
+    monitor; all workers are killed, relaunched with --restart 1, and
+    training finishes from the checkpoints."""
+    r = run_recover(tmp_path, "hang")
+    assert_recovered(r, tmp_path)
+    assert "worker stuck" in r.stderr, r.stderr
+
+
+def test_auto_recover_from_crash(tmp_path):
+    """A worker exits(7) mid-batch: its peer blocks in the collective and
+    trips the monitor; relaunch completes training."""
+    r = run_recover(tmp_path, "crash")
+    assert_recovered(r, tmp_path)
+
+
+def test_healthy_run_is_untouched(tmp_path):
+    """No fault: the monitored runner must not restart anything."""
+    r = run_recover(tmp_path, "none")
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "restarting" not in r.stderr
+    assert "restarted" not in r.stdout
+
+
+def test_garbage_frames_are_shrugged_off(tmp_path):
+    """A peer spraying malformed bytes at transport ports must not crash
+    anyone (parity: kungfu-bad-worker garbage mode); no restart needed."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2",
+            "--", sys.executable, BAD_WORKER,
+            "--mode", "garbage", "--ckpt-dir", str(tmp_path), "--epochs", "3",
+        ],
+        env=env, capture_output=True, text=True, timeout=90, cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "sprayed garbage" in r.stdout
+
+
+def test_cross_host_otherdown(tmp_path):
+    """Two-runner cluster on loopback aliases: the worker on runner B hangs
+    BETWEEN batches (B's own monitor sees nothing), runner A detects its
+    blocked worker and broadcasts otherdown; BOTH runners relaunch and
+    training completes. Parity: monitor.go otherdown:<minEpoch>."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    hosts = "127.0.0.1:1,127.0.0.2:1"
+    peers_flag = "127.0.0.1:7761,127.0.0.2:7762"
+
+    def launch(self_host, monitor_port, runner_port):
+        return subprocess.Popen(
+            [
+                sys.executable, "-m", "kungfu_tpu.runner.cli",
+                "-np", "2", "-H", hosts, "-self", self_host,
+                "-runner-port", str(runner_port),
+                "-auto-recover", "3s",
+                "-monitor-port", str(monitor_port),
+                "-monitor-peers", peers_flag,
+                "--", sys.executable, BAD_WORKER,
+                "--mode", "hang-quiet", "--fault-rank", "1",
+                "--ckpt-dir", str(tmp_path), "--epochs", "3",
+            ],
+            env=env, stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+            text=True, cwd=REPO,
+        )
+
+    a = launch("127.0.0.1", 7761, 38081)
+    b = launch("127.0.0.2", 7762, 38082)
+    try:
+        out_a, err_a = a.communicate(timeout=150)
+        out_b, err_b = b.communicate(timeout=60)
+    except subprocess.TimeoutExpired:
+        a.kill()
+        b.kill()
+        out_a, err_a = a.communicate()
+        out_b, err_b = b.communicate()
+        pytest.fail(
+            f"cross-host recovery timed out\nA out:\n{out_a}\nA err:\n{err_a}"
+            f"\nB out:\n{out_b}\nB err:\n{err_b}"
+        )
+    assert a.returncode == 0, f"A out:\n{out_a}\nA err:\n{err_a}\nB err:\n{err_b}"
+    assert b.returncode == 0, f"B out:\n{out_b}\nB err:\n{err_b}"
+    # A detected its stuck (blocked-in-collective) worker locally...
+    assert "worker stuck" in err_a, err_a
+    # ...and B — whose own monitor saw nothing — restarted via otherdown
+    assert "otherdown" in err_b, err_b
+    assert "restarted from epoch" in out_b, out_b
+    for rank in range(2):
+        assert int((tmp_path / f"rank{rank}.epoch").read_text()) == 2
