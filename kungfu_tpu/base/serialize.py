@@ -1,0 +1,55 @@
+"""Dtype-preserving pytree-leaf serialization for host-plane exchange.
+
+Used by elastic state re-sync (broadcast of live training state to
+joiners) and the PairAveraging p2p model blobs. The wire format is a JSON
+header of (dtype, shape) per leaf followed by each leaf's raw bytes —
+np.savez cannot round-trip ml_dtypes leaves (bfloat16 / float8), which are
+the PRIMARY TPU training dtypes.
+"""
+
+from __future__ import annotations
+
+import json
+import struct
+
+import numpy as np
+
+
+def resolve_dtype(name: str) -> np.dtype:
+    """Resolve a dtype name, including ml_dtypes extension types (bfloat16,
+    float8_*) that plain np.dtype() does not know by string."""
+    try:
+        return np.dtype(name)
+    except TypeError:
+        import ml_dtypes
+
+        return np.dtype(getattr(ml_dtypes, name))
+
+
+def pack_leaves(leaves) -> bytes:
+    """Serialize a list of arrays as raw bytes + explicit dtype/shape."""
+    arrs = [np.asarray(l) for l in leaves]
+    meta = json.dumps(
+        [{"dtype": a.dtype.name, "shape": list(a.shape)} for a in arrs]
+    ).encode()
+    parts = [struct.pack("<Q", len(meta)), meta]
+    for a in arrs:
+        parts.append(np.ascontiguousarray(a).tobytes())
+    return b"".join(parts)
+
+
+def unpack_leaves(blob: bytes, n: int):
+    """Inverse of pack_leaves; validates the leaf count."""
+    (meta_len,) = struct.unpack_from("<Q", blob, 0)
+    meta = json.loads(blob[8 : 8 + meta_len].decode())
+    if len(meta) != n:
+        raise ValueError(f"leaf unpack: expected {n} leaves, got {len(meta)}")
+    out, off = [], 8 + meta_len
+    for m in meta:
+        dt = resolve_dtype(m["dtype"])
+        count = int(np.prod(m["shape"])) if m["shape"] else 1
+        nbytes = count * dt.itemsize
+        a = np.frombuffer(blob, dt, count=count, offset=off).reshape(m["shape"])
+        out.append(a)
+        off += nbytes
+    return out

@@ -21,58 +21,13 @@ re-broadcasts variables + re-syncs progress in its elastic hook).
 from __future__ import annotations
 
 import contextlib
-import json
-import struct
 from typing import Callable, Optional
 
 import numpy as np
 
 from kungfu_tpu import api
-
-
-def _resolve_dtype(name: str) -> np.dtype:
-    """Resolve a dtype name, including ml_dtypes extension types (bfloat16,
-    float8_*) that plain np.dtype() does not know by string."""
-    try:
-        return np.dtype(name)
-    except TypeError:
-        import ml_dtypes
-
-        return np.dtype(getattr(ml_dtypes, name))
-
-
-def _pack_leaves(leaves) -> bytes:
-    """Serialize leaves as raw bytes + explicit dtype/shape metadata.
-
-    np.savez would store ml_dtypes leaves (bfloat16/fp8 — the primary TPU
-    training dtypes) as opaque void arrays that cannot round-trip, so the
-    wire format is our own: a JSON header of (dtype, shape) per leaf
-    followed by each leaf's raw little-endian bytes.
-    """
-    arrs = [np.asarray(l) for l in leaves]
-    meta = json.dumps(
-        [{"dtype": a.dtype.name, "shape": list(a.shape)} for a in arrs]
-    ).encode()
-    parts = [struct.pack("<Q", len(meta)), meta]
-    for a in arrs:
-        parts.append(np.ascontiguousarray(a).tobytes())
-    return b"".join(parts)
-
-
-def _unpack_leaves(blob: bytes, n: int):
-    (meta_len,) = struct.unpack_from("<Q", blob, 0)
-    meta = json.loads(blob[8 : 8 + meta_len].decode())
-    if len(meta) != n:
-        raise ValueError(f"state sync: expected {n} leaves, got {len(meta)}")
-    out, off = [], 8 + meta_len
-    for m in meta:
-        dt = _resolve_dtype(m["dtype"])
-        count = int(np.prod(m["shape"])) if m["shape"] else 1
-        nbytes = count * dt.itemsize
-        a = np.frombuffer(blob, dt, count=count, offset=off).reshape(m["shape"])
-        out.append(a)
-        off += nbytes
-    return out
+from kungfu_tpu.base.serialize import pack_leaves as _pack_leaves
+from kungfu_tpu.base.serialize import unpack_leaves as _unpack_leaves
 
 
 class ElasticState:

@@ -1,0 +1,116 @@
+"""kf-distribute: SSH fan-out launch of a multi-host cluster.
+
+Parity: srcs/go/cmd/kungfu-distribute + utils/ssh — one command starts the
+per-host launcher everywhere, streams prefixed logs, propagates exit
+codes, and tears down on signal. SSH is replaced by a local shim (exec the
+command for any host), the same trick the reference's tests use to
+exercise fan-out without a fleet; the launched cluster is REAL: two kfrun
+runners on loopback aliases forming one 2-worker collective world.
+"""
+
+import os
+import signal
+import stat
+import subprocess
+import sys
+import time
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+HOST_AGENT = os.path.join(REPO, "tests", "integration", "host_agent.py")
+
+
+@pytest.fixture
+def fake_ssh(tmp_path):
+    """An ssh(1) stand-in: `fake_ssh [options...] host command` executes the
+    command locally, like sshing into localhost."""
+    sh = tmp_path / "fake_ssh"
+    sh.write_text("#!/bin/sh\n"
+                  'while [ "${1#-}" != "$1" ]; do shift; shift; done\n'
+                  "shift\n"  # drop host
+                  'exec sh -c "$*"\n')
+    sh.chmod(sh.stat().st_mode | stat.S_IEXEC)
+    return str(sh)
+
+
+def _env():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    return env
+
+
+def test_distribute_launches_real_two_host_cluster(fake_ssh):
+    """One kf-distribute command -> one kfrun per 'host' -> a working
+    2-worker collective cluster running the full host-agent checks."""
+    hosts = "127.0.0.1:1,127.0.0.2:1"
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.distribute",
+            "-H", hosts, "-ssh", fake_ssh,
+            "--", sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2", "-H", hosts, "-self", "{host}",
+            sys.executable, HOST_AGENT,
+        ],
+        env=_env(), capture_output=True, text=True, timeout=120, cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    # per-host prefixed log streaming
+    assert "[127.0.0.1]" in r.stdout and "[127.0.0.2]" in r.stdout, r.stdout
+    assert "OK rank=0/2" in r.stdout and "OK rank=1/2" in r.stdout
+
+
+def test_distribute_propagates_exit_codes(fake_ssh):
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.distribute",
+            "-H", "127.0.0.1:1,127.0.0.2:1", "-ssh", fake_ssh,
+            "--", sys.executable, "-c",
+            "import sys; sys.exit(0 if '{index}' == '0' else 5)",
+        ],
+        env=_env(), capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert r.returncode == 1
+    assert "127.0.0.2" in r.stderr  # names the failing host
+
+
+def test_distribute_substitutes_placeholders(fake_ssh):
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.distribute",
+            "-H", "127.0.0.1:1,127.0.0.2:1", "-ssh", fake_ssh, "-q",
+            "--", "echo", "host={host}", "index={index}",
+        ],
+        env=_env(), capture_output=True, text=True, timeout=60, cwd=REPO,
+    )
+    assert r.returncode == 0, r.stderr
+
+
+def test_distribute_teardown_on_sigterm(fake_ssh):
+    """Ctrl-C / SIGTERM kills every fanned-out child."""
+    p = subprocess.Popen(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.distribute",
+            "-H", "127.0.0.1:1,127.0.0.2:1", "-ssh", fake_ssh,
+            "--", sys.executable, "-c", "import time; time.sleep(300)",
+        ],
+        env=_env(), stdout=subprocess.PIPE, stderr=subprocess.PIPE,
+        text=True, cwd=REPO,
+    )
+    time.sleep(4)  # let children spawn
+    p.send_signal(signal.SIGTERM)
+    try:
+        p.wait(20)
+    except subprocess.TimeoutExpired:
+        p.kill()
+        pytest.fail("kf-distribute did not tear down on SIGTERM")
+    _, err = p.communicate()
+    assert "tearing down" in err, err
+
+
+def test_distribute_requires_hosts():
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.runner.distribute", "--", "true"],
+        env=_env(), capture_output=True, text=True, timeout=30, cwd=REPO,
+    )
+    assert r.returncode == 2
