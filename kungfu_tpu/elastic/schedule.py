@@ -93,7 +93,19 @@ class StepBasedSchedule:
             # proposed recently: the resize flows through the config-server
             # consensus in es.end(); give it time to land
             return None
-        api.propose_new_size(target)
+        try:
+            api.propose_new_size(target)
+        except OSError as e:
+            # transient config-server blip: _last_proposed stays unset so
+            # the very next maybe_propose call retries the PUT; warn so a
+            # PERSISTENT failure is distinguishable from a spent schedule
+            import sys
+
+            print(
+                f"kungfu: propose_new_size({target}) failed ({e}); will retry",
+                file=sys.stderr,
+            )
+            return None
         self._last_proposed = target
         self._proposed_at = time.monotonic()
         return target

@@ -152,7 +152,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     old_term = signal.signal(signal.SIGTERM, teardown)
     if args.timeout:
         signal.signal(signal.SIGALRM, teardown)
-        signal.alarm(int(args.timeout))
+        # setitimer keeps sub-second precision; int() would turn a
+        # timeout < 1s into alarm(0), silently disabling it
+        signal.setitimer(signal.ITIMER_REAL, float(args.timeout))
     try:
         for p in procs:
             p.start()

@@ -46,7 +46,8 @@ class HeartbeatState:
     def __init__(self):
         self._lock = threading.Lock()
         self.in_batch: Dict[int, float] = {}  # rank -> batch begin time
-        self.epochs: Dict[int, int] = {}
+        self.epochs: Dict[int, int] = {}  # per-incarnation completed epochs
+        self.base_epoch = 0  # cluster-wide min at the start of this incarnation
         self.train_ended: Dict[int, bool] = {}
         self.other_down: Optional[int] = None  # min epoch from a remote host
         self.other_finish = False
@@ -73,19 +74,33 @@ class HeartbeatState:
         with self._lock:
             return [r for r, t0 in self.in_batch.items() if now - t0 > grace]
 
-    def min_epoch(self) -> int:
+    def min_epoch(self, n_expected: int = 0) -> int:
+        """Safe resume epoch: base + the min epochs completed THIS
+        incarnation. A rank that hasn't signalled yet contributes 0 — its
+        checkpoint may predate everyone else's — so when n_expected is
+        given and some rank is silent, the increment is 0."""
         with self._lock:
-            return min(self.epochs.values()) if self.epochs else 0
+            if not self.epochs or (n_expected and len(self.epochs) < n_expected):
+                return self.base_epoch
+            return self.base_epoch + min(self.epochs.values())
 
     def all_done(self, n: int) -> bool:
         with self._lock:
             return len(self.train_ended) >= n and all(self.train_ended.values())
 
-    def reset(self) -> None:
+    def reset(self, base_epoch: int = 0) -> None:
+        """Wipe per-incarnation state before a respawn. Epoch counts are
+        per-incarnation (a worker that crashed before its checkpoint write
+        must not inflate the resume point across restarts) and other_finish
+        must clear or every post-finish restart would skip straight to the
+        wait-for-exit branch, disabling stuck detection."""
         with self._lock:
             self.in_batch.clear()
             self.train_ended.clear()
+            self.epochs.clear()
+            self.base_epoch = base_epoch
             self.other_down = None
+            self.other_finish = False
 
 
 class MonitorServer:
@@ -180,7 +195,7 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
             if restart > 0:
                 worker_cmd += ["--restart", "1"]
             procs = make_worker_procs(args, worker_cmd, cluster, self_host, strategy)
-            state.reset()  # before spawn: a begin must never race the wipe
+            state.reset(recover_epoch)  # before spawn: a begin must never race the wipe
             for p in procs:
                 p.env[MONITOR_ADDR_ENV] = f"{self_host}:{monitor.port}"
                 if restart > 0:
@@ -198,10 +213,10 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
                         f"kfrun: workers exited {codes}; restarting",
                         file=sys.stderr,
                     )
-                    recover_epoch = state.min_epoch()
+                    recover_epoch = state.min_epoch(n_local)
                     break
                 if state.stuck_ranks(grace):
-                    recover_epoch = state.min_epoch()
+                    recover_epoch = state.min_epoch(n_local)
                     print(
                         f"kfrun: worker stuck > {grace}s at epoch {recover_epoch}; restarting",
                         file=sys.stderr,
@@ -213,7 +228,7 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
                     # the broadcast carries the DETECTING host's min epoch:
                     # every host must resume from the cluster-wide min, not
                     # its own (a fast host would otherwise skip ahead)
-                    recover_epoch = min(state.min_epoch(), state.other_down)
+                    recover_epoch = min(state.min_epoch(n_local), state.other_down)
                     print(
                         f"kfrun: otherdown:{state.other_down} received; restarting",
                         file=sys.stderr,
@@ -231,13 +246,16 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
                         except subprocess.TimeoutExpired:
                             p.kill()
                             codes.append(-1)
-                    if peers and state.all_done(n_local):
-                        for addr in peers:
-                            _post(addr, "otherfinish:0")
                     if all(c == 0 for c in codes):
+                        # broadcast only after exit codes confirm success:
+                        # a premature otherfinish would let peers shut down
+                        # while this host restarts into an empty cluster
+                        if peers and state.all_done(n_local):
+                            for addr in peers:
+                                _post(addr, "otherfinish:0")
                         return 0
                     failed = True
-                    recover_epoch = state.min_epoch()
+                    recover_epoch = state.min_epoch(n_local)
                     print(
                         f"kfrun: workers exited {codes} after trainend; restarting",
                         file=sys.stderr,

@@ -129,8 +129,9 @@ class TestMaybeProposeRetry:
 
         calls = self._patch(monkeypatch, rank=0, size=2, fail_once=True)
         s = StepBasedSchedule("4:10")
-        with pytest.raises(ConnectionError):
-            s.maybe_propose(0)  # PUT fails -> _last_proposed NOT recorded
+        # transient PUT failure is swallowed (ADVICE r3): the proposing
+        # worker must not die over a blip; _last_proposed stays unset
+        assert s.maybe_propose(0) is None
         assert s.maybe_propose(1) == 4  # retried
         assert calls == [4]
         assert s.maybe_propose(2) is None  # proposed, awaiting consensus

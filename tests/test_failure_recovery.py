@@ -139,3 +139,34 @@ def test_cross_host_otherdown(tmp_path):
     assert "restarted from epoch" in out_b, out_b
     for rank in range(2):
         assert int((tmp_path / f"rank{rank}.epoch").read_text()) == 2
+
+
+class TestHeartbeatStateReset:
+    """Per-incarnation state semantics (ADVICE r3)."""
+
+    def test_reset_clears_other_finish(self):
+        from kungfu_tpu.runner.monitored import HeartbeatState
+
+        s = HeartbeatState()
+        s.signal("otherfinish", 0)
+        assert s.other_finish
+        s.reset()
+        assert not s.other_finish
+
+    def test_epochs_are_per_incarnation(self):
+        from kungfu_tpu.runner.monitored import HeartbeatState
+
+        s = HeartbeatState()
+        for _ in range(3):
+            s.signal("epoch", 0)
+            s.signal("epoch", 1)
+        assert s.min_epoch(2) == 3
+        # restart resuming from epoch 3: counts restart at the base
+        s.reset(base_epoch=3)
+        assert s.min_epoch(2) == 3
+        s.signal("epoch", 0)
+        # rank 1 silent this incarnation -> its checkpoint may still be
+        # at 3, so the safe resume point must not advance
+        assert s.min_epoch(2) == 3
+        s.signal("epoch", 1)
+        assert s.min_epoch(2) == 4
