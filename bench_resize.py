@@ -43,6 +43,9 @@ while not es.stopped():
             target = SIZES[(step // 10) % len(SIZES)]
             if target != size:
                 api.propose_new_size(target)
+        time.sleep(0.4)  # stand-in for a real train step: preemption-driven
+        # resizes are minutes apart in the BASELINE scenario, so warm
+        # spares have warmed by the time a join needs one
         t0 = time.perf_counter()
         before = size
         es.end(1)
@@ -51,8 +54,10 @@ while not es.stopped():
         # resize cost as seen by a survivor
         if not es.stopped() and api.cluster_size() != before:
             dt = (time.perf_counter() - t0) * 1000
-            print(f"RESIZE {before} -> {api.cluster_size()} took {dt:.1f} ms",
-                  flush=True)
+            import json as _json
+            phases = _json.dumps(api.last_resize_phases())
+            print(f"RESIZE {before} -> {api.cluster_size()} took {dt:.1f} ms"
+                  f" phases={phases}", flush=True)
 print(f"done rank={api.current_rank()} reason={es.stop_reason}", flush=True)
 '''
 
@@ -70,6 +75,7 @@ def main() -> None:
                 "-np", "2",
                 "-H", "127.0.0.1:4",
                 "-w",
+                "-warm-spares", "2",
                 "-builtin-config-port", "0",
                 "--", sys.executable, agent_path,
             ],
@@ -78,6 +84,14 @@ def main() -> None:
     finally:
         os.unlink(agent_path)
     lat = [float(m) for m in re.findall(r"took ([0-9.]+) ms", r.stdout)]
+    # per-phase medians (wait_config / consensus / notify / update)
+    phase_samples: dict = {}
+    for m in re.findall(r"phases=(\{[^}]*\})", r.stdout):
+        for k, v in json.loads(m).items():
+            phase_samples.setdefault(k, []).append(float(v))
+    phase_medians = {
+        k: sorted(v)[len(v) // 2] for k, v in sorted(phase_samples.items())
+    }
     if r.returncode != 0 or not lat:
         print(json.dumps({
             "metric": "elastic_resize_latency",
@@ -97,6 +111,7 @@ def main() -> None:
         "n_resizes": len(lat),
         "min_ms": round(lat[0], 1),
         "max_ms": round(lat[-1], 1),
+        "phase_median_ms": phase_medians,
     }))
 
 

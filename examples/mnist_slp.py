@@ -6,8 +6,9 @@ optimizer, broadcast initial weights, train data-parallel. Run it:
   python examples/mnist_slp.py                       # single process, all local devices
   kfrun -np 4 python examples/mnist_slp.py           # 4-process host cluster (CPU)
 
-Uses synthetic MNIST-shaped data (this environment has no dataset egress);
-swap `synthetic_mnist` with a real loader outside.
+Uses synthetic MNIST-shaped data by default (this environment has no
+dataset egress); pass ``--data <dir>`` with the standard idx[.gz] files to
+train on real MNIST (kungfu_tpu.datasets.load_mnist).
 """
 
 import argparse
@@ -37,13 +38,22 @@ def main():
     p.add_argument("--epochs", type=int, default=3)
     p.add_argument("--batch", type=int, default=512)
     p.add_argument("--lr", type=float, default=0.5)
+    p.add_argument("--data", default="",
+                   help="directory with the 4 MNIST idx[.gz] files; "
+                        "synthetic data when omitted")
     args = p.parse_args()
 
     mesh = make_mesh()  # all local devices on 'dp'
     ndev = mesh.devices.size
     batch = (args.batch // ndev) * ndev or ndev
 
-    x, y = synthetic_mnist()
+    if args.data:
+        from kungfu_tpu.datasets import load_mnist
+
+        d = load_mnist(args.data)
+        x, y = d["train_images"], d["train_labels"]
+    else:
+        x, y = synthetic_mnist()
     params = broadcast_variables(init_mlp(jax.random.PRNGKey(42)), mesh)
     opt = synchronous_sgd(optax.sgd(args.lr), "dp")
     state = replicate(opt.init(jax.device_get(params)), mesh)

@@ -68,10 +68,28 @@ def all_reduce_array(
     """Host-plane allreduce of a numpy array (control data, NOT gradients —
     those belong on the ICI plane via kungfu_tpu.ops)."""
     flat = np.ascontiguousarray(x).reshape(-1)
-    out = np.zeros_like(flat)
+    # empty, not zeros: every element of recv is written by the graph walk
+    # (forward / transform2 / copyto), and zeroing 100 MB gradient sets per
+    # call is measurable
+    out = np.empty_like(flat)
     w = Workspace(send=flat, recv=out, op=op, name=f"kungfu::user::{name}")
     get_default_peer().current_session().all_reduce(w)
     return out.reshape(x.shape)
+
+
+def group_all_reduce_arrays(
+    xs, op: ReduceOp = ReduceOp.SUM, name: str = "group"
+):
+    """Concurrent host-plane allreduce of a list of arrays (one windowed
+    group op — the way the reference reduces a whole gradient set)."""
+    flats = [np.ascontiguousarray(x).reshape(-1) for x in xs]
+    outs = [np.empty_like(f) for f in flats]
+    ws = [
+        Workspace(send=f, recv=o, op=op, name=f"kungfu::user::{name}:{i}")
+        for i, (f, o) in enumerate(zip(flats, outs))
+    ]
+    get_default_peer().current_session().group_all_reduce(ws)
+    return [o.reshape(x.shape) for o, x in zip(outs, xs)]
 
 
 def all_reduce_int_max(x: int) -> int:
@@ -99,6 +117,12 @@ def propose_new_size(new_size: int) -> None:
     get_default_peer().propose_new_size(new_size)
 
 
+def last_resize_phases() -> dict:
+    """Per-phase ms breakdown of the most recent resize seen by this peer
+    (wait_config / consensus / notify / update)."""
+    return dict(get_default_peer().last_resize_phases)
+
+
 def change_cluster(progress: int):
     return get_default_peer().change_cluster(progress)
 
@@ -109,7 +133,10 @@ def monitored_all_reduce_array(
     """Host-plane allreduce with throughput accounting feeding the adaptive
     controller (parity: MonitoredAllReduce op)."""
     flat = np.ascontiguousarray(x).reshape(-1)
-    out = np.zeros_like(flat)
+    # empty, not zeros: every element of recv is written by the graph walk
+    # (forward / transform2 / copyto), and zeroing 100 MB gradient sets per
+    # call is measurable
+    out = np.empty_like(flat)
     w = Workspace(send=flat, recv=out, op=op, name=f"kungfu::monitored::{name}")
     get_default_peer().current_session().monitored_all_reduce(w)
     return out.reshape(x.shape)
@@ -262,13 +289,22 @@ def queue_get(src: int, qid: int, timeout: float = 30.0) -> bytes:
     )
 
 
-def save(name: str, data: bytes) -> None:
-    """Publish a blob to this peer's store (parity: SaveVariable)."""
-    get_default_peer().p2p.save(name, data)
+def save(name: str, data: bytes, version: Optional[int] = None) -> None:
+    """Publish a blob to this peer's store (parity: SaveVariable). With a
+    version, the blob is an immutable entry in the versioned store (GC
+    window 3) — the consistency contract PairAveraging readers rely on."""
+    p = get_default_peer()
+    if version is None:
+        p.p2p.save(name, data)
+    else:
+        p.p2p.save_version(version, name, data)
 
 
-def request(rank: int, name: str) -> Optional[bytes]:
-    """Fetch a blob from peer `rank`'s store (parity: RequestVariable)."""
+def request(
+    rank: int, name: str, version: "Optional[int | str]" = None
+) -> Optional[bytes]:
+    """Fetch a blob from peer `rank`'s store (parity: RequestVariable).
+    version: None = flat store; an int or "latest" = versioned store."""
     p = get_default_peer()
     sess = p.current_session()
-    return p.p2p.request(sess.peers[rank], name)
+    return p.p2p.request(sess.peers[rank], name, version=version)

@@ -61,8 +61,7 @@ def bench_host(model: str, iters: int) -> None:
     samples = []
     for i in range(iters):
         t0 = time.perf_counter()
-        for j, g in enumerate(grads):
-            api.all_reduce_array(g, name=f"bench:{i}:{j}")
+        api.group_all_reduce_arrays(grads, name=f"bench:{i}")
         dt = time.perf_counter() - t0
         samples.append(total_bytes / dt / (1 << 30))
     mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))

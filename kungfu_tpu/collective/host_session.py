@@ -15,6 +15,7 @@ rchannel data plane.
 
 from __future__ import annotations
 
+import os
 import threading
 import time
 from typing import Callable, List, Optional, Sequence
@@ -31,9 +32,13 @@ from kungfu_tpu.plan.peer import PeerID, PeerList
 from kungfu_tpu.transport.client import Client
 from kungfu_tpu.transport.handlers import CollectiveEndpoint
 from kungfu_tpu.transport.message import ConnType, Flags
+from kungfu_tpu.utils.pool import get_buffer_pool, get_pool
 from kungfu_tpu.utils.stall import stall_detect
 
-CHUNK_BYTES = 1 << 20  # 1 MiB, parity: session.go chunkSize
+# 1 MiB default, parity: session.go chunkSize; tunable because the optimal
+# trades chunk-walk overhead (fewer, bigger chunks) against striping/
+# pipelining (more, smaller chunks) and depends on host core count
+CHUNK_BYTES = int(os.environ.get("KF_CONFIG_CHUNK_BYTES", str(1 << 20)))
 DEFAULT_TIMEOUT = 120.0
 
 
@@ -42,42 +47,55 @@ def _par(
     timeout: float,
     cancel: Optional[threading.Event] = None,
 ) -> None:
-    """Run callables in parallel threads, join, re-raise the first error
-    (goroutine-style fan-out; avoids pool-exhaustion deadlocks on nested
-    parallelism).
+    """Run callables on the shared cached-thread pool, wait for all,
+    re-raise the first error (goroutine-style fan-out; an unbounded cached
+    pool avoids both thread-spawn cost per call and pool-exhaustion
+    deadlocks on nested parallelism).
 
-    All joins share ONE deadline (worst case = timeout, not
+    All waits share ONE deadline (worst case = timeout, not
     len(fns)*timeout). On timeout `cancel` is set before raising so
-    abandoned daemon workers that later complete a recv can observe it and
-    must NOT mutate the caller's workspace (a reused recv buffer would be
+    abandoned workers that later complete a recv can observe it and must
+    NOT mutate the caller's workspace (a reused recv buffer would be
     corrupted by a late write)."""
     if not fns:
         return
     if len(fns) == 1:
         fns[0]()
         return
+    cond = threading.Condition()
+    state = {"done": 0}
     errs: List[BaseException] = []
-    lock = threading.Lock()
 
     def run(fn):
+        err: Optional[BaseException] = None
         try:
             fn()
         except BaseException as e:  # noqa: BLE001 - propagated below
-            with lock:
-                errs.append(e)
+            err = e
+        with cond:
+            state["done"] += 1
+            if err is not None:
+                errs.append(err)
+            cond.notify_all()
 
-    threads = [threading.Thread(target=run, args=(fn,), daemon=True) for fn in fns]
-    for t in threads:
-        t.start()
-    deadline = time.monotonic() + timeout
-    for t in threads:
-        t.join(max(0.0, deadline - time.monotonic()))
-        if t.is_alive():
+    pool = get_pool()
+    for fn in fns:
+        pool.submit(lambda f=fn: run(f))
+    with cond:
+        if not cond.wait_for(lambda: state["done"] >= len(fns), timeout):
             if cancel is not None:
                 cancel.set()
             raise TimeoutError("collective thread timed out")
-    if errs:
-        raise errs[0]
+        if errs:
+            raise errs[0]
+
+
+def _buf(arr: np.ndarray):
+    """Zero-copy byte view of a contiguous array (tobytes() fallback)."""
+    try:
+        return arr.data.cast("B")
+    except (ValueError, TypeError, AttributeError):
+        return arr.tobytes()
 
 
 
@@ -147,6 +165,34 @@ class HostSession:
     def all_reduce(self, w: Workspace) -> None:
         with stall_detect(f"all_reduce({w.name})"):
             self._run_strategies(w, self.global_strategies)
+
+    # concurrent workspaces per batch in group ops: concurrency only pays
+    # when cores exist to run the walks (on a 1-core host it just adds
+    # context switches), so the default scales with cpu count;
+    # KF_CONFIG_GROUP_WINDOW overrides
+    GROUP_WINDOW = int(
+        os.environ.get("KF_CONFIG_GROUP_WINDOW", "")
+        or max(1, min(8, os.cpu_count() or 1))
+    )
+
+    def group_all_reduce(self, ws: Sequence[Workspace]) -> None:
+        """Concurrent allreduce of many workspaces (parity: the reference
+        runs one collective per tensor through the NCCL-scheduler queue in
+        a single session.run — srcs/python/kungfu/tensorflow/v1/benchmarks).
+        Windowed so a 160-tensor gradient set doesn't explode into
+        thousands of in-flight chunk walks."""
+        if not ws:
+            return
+        with stall_detect(f"group_all_reduce[{len(ws)}]"):
+            for i in range(0, len(ws), self.GROUP_WINDOW):
+                batch = ws[i : i + self.GROUP_WINDOW]
+                _par(
+                    [
+                        lambda w=w: self._run_strategies(w, self.global_strategies)
+                        for w in batch
+                    ],
+                    self.timeout,
+                )
 
     def monitored_all_reduce(self, w: Workspace) -> None:
         """AllReduce + throughput accounting for the ACTIVE strategy
@@ -316,7 +362,7 @@ class HostSession:
         root = 0
         if self.rank != root:
             self.client.send(
-                self.peers[root], w.name, w.send.tobytes(), ConnType.COLLECTIVE
+                self.peers[root], w.name, _buf(w.send), ConnType.COLLECTIVE
             )
             return
         cancel = threading.Event()
@@ -409,13 +455,33 @@ class HostSession:
             return w.send
 
         def send_to(peer: PeerID, flags: Flags = Flags.NONE) -> None:
+            # zero-copy: the walk's phases are sequential per chunk, so the
+            # buffer cannot be mutated while sendall drains it
             self.client.send(
-                peer, w.name, effective().tobytes(), ConnType.COLLECTIVE, flags
+                peer, w.name, _buf(effective()), ConnType.COLLECTIVE, flags
             )
 
+        bufpool = get_buffer_pool()
+        nbytes = w.recv.size * w.recv.itemsize
+
+        def recv_payload(peer: PeerID):
+            """Receive (peer, w.name) into a pooled scratch buffer —
+            delivered straight off the socket when we're parked first
+            (sink path), else from the buffered Message. Returns
+            (ndarray view, scratch-or-None to return to the pool)."""
+            scratch = bufpool.get(nbytes)
+            # on error the scratch is deliberately NOT returned to the pool:
+            # a timed-out sink may still be mid-fill by the transport thread
+            msg, filled = self.endpoint.recv_into(
+                peer, w.name, memoryview(scratch), self.timeout
+            )
+            if filled:
+                return np.frombuffer(scratch, w.send.dtype), scratch
+            bufpool.put(scratch)  # unused: sender raced us or size mismatch
+            return np.frombuffer(msg.data, w.send.dtype), None
+
         def recv_onto(peer: PeerID) -> None:
-            msg = self.endpoint.recv(peer, w.name, self.timeout)
-            incoming = np.frombuffer(msg.data, w.send.dtype)
+            incoming, scratch = recv_payload(peer)
             with lock:
                 if cancel.is_set():
                     # abort the whole walk: a late arrival must neither write
@@ -429,15 +495,18 @@ class HostSession:
                 else:
                     reduce_inplace(w.recv, incoming, w.op)
                 state["recv_count"] += 1
+            if scratch is not None:
+                bufpool.put(scratch)
 
         def recv_into(peer: PeerID) -> None:
-            msg = self.endpoint.recv(peer, w.name, self.timeout)
+            incoming, scratch = recv_payload(peer)
             with lock:
                 if cancel.is_set():
                     raise TimeoutError(f"collective cancelled: {w.name}")
-                src = np.frombuffer(msg.data, w.recv.dtype)
-                np.copyto(w.recv, src)
+                np.copyto(w.recv, incoming)
                 state["recv_count"] += 1
+            if scratch is not None:
+                bufpool.put(scratch)
 
         for g in graphs:
             prevs = [self.peers[r] for r in g.prevs(self.rank)]

@@ -47,7 +47,16 @@ def choose(sl: StrategyList, i: int) -> StrategyPair:
 
 
 def auto_select(peers: PeerList) -> Strategy:
-    return Strategy.STAR if peers.host_count() == 1 else Strategy.BINARY_TREE_STAR
+    """Single host: CLIQUE (one star per root) so chunked collectives
+    stripe across k roots instead of funnelling 2(k-1)x the payload
+    through rank 0 — on localhost/DCN the per-process socket loop is the
+    bottleneck, so multi-root striping is a ~kx bandwidth win. Pair 0 is
+    rank-0-rooted, preserving the gather/broadcast root contract.
+    Multi-host: one binary-tree-star per host master (same striping
+    argument across hosts)."""
+    if peers.host_count() == 1:
+        return Strategy.CLIQUE if len(peers) > 2 else Strategy.STAR
+    return Strategy.MULTI_BINARY_TREE_STAR
 
 
 def _star(peers: PeerList) -> StrategyList:

@@ -7,6 +7,12 @@ from kungfu_tpu.ops.collective import (
     group_all_reduce,
     subset_all_reduce,
 )
+from kungfu_tpu.ops.hierarchical import (
+    CrossSliceReducer,
+    cross_slice_mean,
+    make_hier_train_step,
+    synchronous_sgd_hierarchical,
+)
 
 __all__ = [
     "all_gather",
@@ -16,4 +22,8 @@ __all__ = [
     "fuse",
     "group_all_reduce",
     "subset_all_reduce",
+    "CrossSliceReducer",
+    "cross_slice_mean",
+    "make_hier_train_step",
+    "synchronous_sgd_hierarchical",
 ]

@@ -18,6 +18,7 @@ vector as a plain input.
 
 from __future__ import annotations
 
+import json
 import random
 import threading
 from typing import Callable, List, Optional, Tuple
@@ -28,9 +29,13 @@ import numpy as np
 import optax
 
 
-def _fuse_host(tree) -> np.ndarray:
-    leaves = jax.tree.leaves(jax.device_get(tree))
-    return np.concatenate([np.ravel(np.asarray(l, np.float32)) for l in leaves])
+def _pack_host(tree) -> bytes:
+    """Dtype-faithful wire blob: raw leaf bytes + dtype/shape header
+    (base/serialize.py) — bf16 models exchange losslessly; an f32 flatten
+    would corrupt bf16/f64 params in transit."""
+    from kungfu_tpu.base.serialize import pack_leaves
+
+    return pack_leaves(jax.tree.leaves(jax.device_get(tree)))
 
 
 class PairAveraging:
@@ -60,26 +65,28 @@ class PairAveraging:
         self._fetched: List[Optional[np.ndarray]] = [None]  # per-thread slot
         self._shapes = None
         self._step_fns = {}
+        # per-step publish version: each publish is an immutable
+        # (version, blob) in the VersionedStore (GC window 3), so a reader
+        # mid-request gets a consistent snapshot while we publish the next
+        # (parity: p2p.go versioned requests)
+        self._version = 0
 
     # -- jitted compute ------------------------------------------------
     def _build(self, params):
         leaves, treedef = jax.tree.flatten(params)
-        shapes = [l.shape for l in leaves]
-        dtypes = [l.dtype for l in leaves]
-        sizes = [int(np.prod(s)) if s else 1 for s in shapes]
-        self._shapes = (treedef, shapes, dtypes, sizes)
-
-        def unflatten(vec):
-            out, off = [], 0
-            for shape, dt, size in zip(shapes, dtypes, sizes):
-                out.append(jnp.reshape(vec[off:off + size], shape).astype(dt))
-                off += size
-            return jax.tree.unflatten(treedef, out)
+        self._shapes = (treedef, len(leaves))
 
         @jax.jit
-        def avg_apply(params, other_vec, grads, opt_state):
-            other = unflatten(other_vec)
-            params = jax.tree.map(lambda p, o: 0.5 * (p + o), params, other)
+        def avg_apply(params, other, grads, opt_state):
+            # average in f32 regardless of storage dtype (a bf16 0.5*(p+o)
+            # loses a mantissa bit per step), round back to the param dtype
+            params = jax.tree.map(
+                lambda p, o: (
+                    0.5 * (p.astype(jnp.float32) + o.astype(jnp.float32))
+                ).astype(p.dtype),
+                params,
+                other,
+            )
             updates, opt_state = self.base.update(grads, opt_state, params)
             return optax.apply_updates(params, updates), opt_state
 
@@ -103,15 +110,17 @@ class PairAveraging:
         if target is None:
             return
 
-        slot: List[Optional[np.ndarray]] = [None]
+        slot: List[Optional[bytes]] = [None]
 
         def fetch():
             sess = self.peer.current_session()
             try:
-                data = self.peer.p2p.request(sess.peers[target], self.blob, timeout=30)
+                data = self.peer.p2p.request(
+                    sess.peers[target], self.blob, timeout=30, version="latest"
+                )
             except (ConnectionError, TimeoutError, OSError):
                 data = None
-            slot[0] = np.frombuffer(data, np.float32) if data is not None else None
+            slot[0] = data
 
         self._fetched = slot
         self._prefetch = threading.Thread(target=fetch, daemon=True)
@@ -121,29 +130,52 @@ class PairAveraging:
         """Publish the initial model, fence, start the first prefetch
         (parity: async_sgd.py:106-108 init-store + barrier)."""
         self._build(params)
-        self.peer.p2p.save(self.blob, _fuse_host(params).tobytes())
+        self.peer.p2p.save_version(self._version, self.blob, _pack_host(params))
         if not self.peer.config.single_process:
             self.peer.current_session().barrier(tag=":pair-avg-init")
         self._start_prefetch()
         return self.base.init(params)
 
+    def _unpack_other(self, blob) -> Optional[object]:
+        """Wire blob -> params-shaped pytree (None on malformed data — a
+        stale peer mid-resize may serve a different-shaped model)."""
+        from kungfu_tpu.base.serialize import unpack_leaves
+
+        import struct
+
+        treedef, n = self._shapes
+        try:
+            leaves = unpack_leaves(bytes(blob), n)
+        except (
+            ValueError,  # wrong leaf count / bad reshape (json.JSONDecodeError too)
+            KeyError,  # header missing dtype/shape
+            struct.error,  # blob shorter than the length prefix
+            UnicodeDecodeError,  # garbage where the json header should be
+            AttributeError,  # unknown dtype name in resolve_dtype
+        ):
+            return None
+        return jax.tree.unflatten(treedef, leaves)
+
     def step(self, params, opt_state, grads):
         """One training step; call with the already-computed LOCAL grads."""
-        other: Optional[np.ndarray] = None
+        other_blob: Optional[bytes] = None
         if self._prefetch is not None:
             self._prefetch.join(timeout=30)
             if not self._prefetch.is_alive():
                 # orphaned fetches keep writing only their own slot, so a
                 # timed-out thread can never clobber a later prefetch
-                other = self._fetched[0]
+                other_blob = self._fetched[0]
             self._prefetch = None
-        if other is not None and other.size:
+        other = self._unpack_other(other_blob) if other_blob else None
+        if other is not None:
             params, opt_state = self._step_fns["avg"](
-                params, jnp.asarray(other), grads, opt_state
+                params, other, grads, opt_state
             )
         else:
             params, opt_state = self._step_fns["plain"](params, grads, opt_state)
-        # publish new model, then overlap the next fetch with caller compute
-        self.peer.p2p.save(self.blob, _fuse_host(params).tobytes())
+        # publish new model as the next immutable version, then overlap the
+        # next fetch with caller compute
+        self._version += 1
+        self.peer.p2p.save_version(self._version, self.blob, _pack_host(params))
         self._start_prefetch()
         return params, opt_state

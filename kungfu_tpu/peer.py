@@ -35,7 +35,7 @@ from kungfu_tpu.transport.handlers import (
 )
 from kungfu_tpu.transport.message import ConnType, Flags, Message
 from kungfu_tpu.transport.server import Server
-from kungfu_tpu.utils import log
+from kungfu_tpu.utils import log, trace
 from kungfu_tpu.utils.stall import stall_detect
 
 _default_peer: Optional["Peer"] = None
@@ -74,6 +74,11 @@ class Peer:
         # startup, >1 once it survives a delta resize. Lets elastic state
         # sync pick a provably surviving broadcast root.
         self.epoch_count = 0
+        # per-phase wall-clock (ms) of the most recent resize, as seen by
+        # this (surviving) peer: wait_config / consensus / notify / update
+        # (update = reconnect + new-session barrier, i.e. joiner-bounded).
+        # Parity: the reference's ResizeProfiler phase breakdown.
+        self.last_resize_phases: dict = {}
 
         self.store = BlobStore()
         self.client = Client(self.self_id, use_unix=not config.single_process)
@@ -87,6 +92,19 @@ class Peer:
 
     # ------------------------------------------------------------------
     def start(self) -> None:
+        import os
+
+        spawn_ts = os.environ.get("KF_SPAWN_TS", "")
+        if spawn_ts:
+            # joiner-readiness latency: runner spawn (or standby
+            # activation) -> host plane up; the term that bounds the
+            # survivors' rebuild barrier during an elastic grow
+            try:
+                startup = time.time() - float(spawn_ts)
+                trace.record("worker.startup", startup)
+                log.info("worker ready %.0f ms after spawn", startup * 1e3)
+            except ValueError:
+                pass
         if not self.config.single_process:
             self.server.start()
         self._start_metrics_server()
@@ -186,20 +204,38 @@ class Peer:
         peers must agree on the proposed bytes or the resize is rejected.
         """
         sess = self.current_session()
-        if not sess.bytes_consensus(cluster.to_bytes(), f":propose:v{self.cluster_version}"):
+        t0 = time.perf_counter()
+        with trace.span("resize.consensus"):
+            agreed = sess.bytes_consensus(
+                cluster.to_bytes(), f":propose:v{self.cluster_version}"
+            )
+        if not agreed:
             return False, True
         if self._peers == cluster.workers:
             return True, True  # no change
+        self.last_resize_phases = {
+            "consensus_ms": round((time.perf_counter() - t0) * 1e3, 1)
+        }
         stage = {
             "Version": self.cluster_version + 1,
             "Progress": progress,
             "Cluster": cluster.to_json(),
         }
         if sess.rank == 0 and self.config.runners:
-            self._notify_runners(stage)
+            t1 = time.perf_counter()
+            with trace.span("resize.notify"):
+                self._notify_runners(stage)
+            self.last_resize_phases["notify_ms"] = round(
+                (time.perf_counter() - t1) * 1e3, 1
+            )
         # all peers advance the version together (they all ran the consensus)
         self.cluster_version += 1
-        keep = self._update_to(cluster.workers)
+        t2 = time.perf_counter()
+        with trace.span("resize.update"):
+            keep = self._update_to(cluster.workers)
+        self.last_resize_phases["update_ms"] = round(
+            (time.perf_counter() - t2) * 1e3, 1
+        )
         return True, keep
 
     def _get_config(self, url: str, attempts: int = 3) -> Optional[Cluster]:
@@ -236,10 +272,18 @@ class Peer:
         url = self.config.config_server
         if not url:
             return False, False
-        cluster = self._wait_new_config(url)
+        t0 = time.perf_counter()
+        with trace.span("resize.wait_config"):
+            cluster = self._wait_new_config(url)
+        wait_ms = round((time.perf_counter() - t0) * 1e3, 1)
         if cluster.workers == self._peers:
             return False, False
         accepted, keep = self._propose(cluster)
+        if accepted:
+            # only stamp onto the record _propose just rebuilt; a rejected
+            # proposal must not splice this wait into the PREVIOUS
+            # resize's phase breakdown
+            self.last_resize_phases["wait_config_ms"] = wait_ms
         return accepted, not keep
 
     def resize_cluster(self, new_size: int) -> Tuple[bool, bool]:

@@ -55,6 +55,16 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("-monitor-peers", default="",
                    help="all runners' monitor host:port list (default: "
                         "every runner host on -monitor-port)")
+    p.add_argument("-warm-spares", type=int, default=1,
+                   help="standby workers kept warm per runner in -w mode "
+                        "(0 disables); activation replaces cold joiner "
+                        "spawn+import during an elastic grow")
+    p.add_argument("-standby-preload", default="",
+                   help="extra comma-separated modules standbys pre-import "
+                        "(e.g. jax for device-plane agents)")
+    p.add_argument("-use-affinity", action="store_true",
+                   help="pin each local worker to a disjoint, NUMA-aligned "
+                        "CPU slice (parity: KUNGFU_USE_AFFINITY)")
     p.add_argument("-devices-per-host", type=int, default=0,
                    help="partition this many chip ids among local workers "
                         "(TPU_VISIBLE_DEVICES pinning; 0 = no pinning)")
@@ -162,7 +172,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         )
 
     try:
-        if args.auto_recover:
+        if args.auto_recover and not args.watch:
             from kungfu_tpu.runner.monitored import monitored_run
 
             return monitored_run(args, cmd, cluster, self_host, strategy)
@@ -195,6 +205,7 @@ def make_one_worker_proc(
         device_slots=device_slots,
     )
     env["KF_LOG_PREFIX"] = f"{rank}/{len(cluster.workers)}"
+    env["KF_SPAWN_TS"] = str(time.time())
     return WorkerProc(
         name=f"{rank}/{len(cluster.workers)}",
         argv=list(cmd),
@@ -221,13 +232,21 @@ def make_worker_procs(
             )
         # static membership (simple/monitored runs): rank-major stripes
         slot_parts = partition(n_dev, len(local))
-    return [
+    cpu_parts: List[Optional[list]] = [None] * len(local)
+    if getattr(args, "use_affinity", False) and local:
+        from kungfu_tpu.runner.affinity import plan_affinity
+
+        cpu_parts = plan_affinity(len(local))
+    procs = [
         make_one_worker_proc(
             args, cmd, cluster, w, self_host, strategy, config_server_url,
             version, progress, device_slots=slot_parts[i],
         )
         for i, w in enumerate(local)
     ]
+    for p, cpus in zip(procs, cpu_parts):
+        p.cpus = cpus
+    return procs
 
 
 def simple_run(args, cmd, cluster, self_host, strategy, config_server_url="") -> int:

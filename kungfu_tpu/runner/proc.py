@@ -31,6 +31,7 @@ class WorkerProc:
         rank: int = 0,
         logdir: Optional[str] = None,
         quiet: bool = False,
+        cpus: Optional[List[int]] = None,
     ):
         self.name = name
         self.argv = argv
@@ -38,6 +39,7 @@ class WorkerProc:
         self.rank = rank
         self.logdir = logdir
         self.quiet = quiet
+        self.cpus = cpus  # CPU affinity mask (runner/affinity.py plan)
         self.proc: Optional[subprocess.Popen] = None
         self._threads: List[threading.Thread] = []
 
@@ -52,6 +54,14 @@ class WorkerProc:
             text=True,
             bufsize=1,
         )
+        if self.cpus:
+            from kungfu_tpu.runner.affinity import apply_affinity
+
+            if apply_affinity(self.proc.pid, self.cpus) and not self.quiet:
+                print(
+                    f"[{self.name}] pinned to cpus {self.cpus}",
+                    file=sys.stderr,
+                )
         logfile = None
         if self.logdir:
             os.makedirs(self.logdir, exist_ok=True)
@@ -64,8 +74,10 @@ class WorkerProc:
             self._threads.append(t)
 
     def _pump(self, stream, tag: str, logfile) -> None:
-        prefix = _color(self.rank, f"[{self.name}{tag}] ")
         for line in stream:
+            # prefix computed per line: a standby proc is renamed to its
+            # worker identity on activation
+            prefix = _color(self.rank, f"[{self.name}{tag}] ")
             if logfile:
                 logfile.write(f"[{tag or ' '}] {line}")
                 logfile.flush()

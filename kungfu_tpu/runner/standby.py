@@ -1,0 +1,218 @@
+"""Warm-spare workers: pre-spawned processes that pay the interpreter +
+numpy import cost BEFORE a resize needs them.
+
+The dominant term of elastic-join latency is joiner startup (python +
+numpy import is seconds of CPU on a busy host); the reference hides the
+equivalent cost behind its always-resident Go runner. TPU-native design: the
+elastic watcher keeps N standby processes alive; activating one costs a
+FIFO write instead of a cold exec.
+
+Protocol:
+- the watcher spawns ``python -m kungfu_tpu.runner.standby`` with
+  ``KF_STANDBY_FIFO=<path>`` (and optional ``KF_STANDBY_PRELOAD`` — extra
+  comma-separated modules to import while waiting);
+- the standby opens the FIFO for reading IMMEDIATELY (so activation can be
+  written at any point, even mid-warmup), warms its imports, then blocks
+  on the FIFO;
+- activation is one JSON line ``{"env": {...}, "argv": [...]}``: the
+  standby applies the env, sets sys.argv and runs the worker command
+  in-process (runpy) when it is a python invocation, exec()-ing otherwise.
+"""
+
+from __future__ import annotations
+
+import errno
+import json
+import os
+import sys
+import time
+from typing import List, Optional
+
+
+def _is_python(arg: str) -> bool:
+    base = os.path.basename(arg)
+    return base.startswith("python") or arg == sys.executable
+
+
+def run_activated(spec: dict) -> None:
+    """Apply an activation spec and run the worker command in-process."""
+    import runpy
+
+    os.environ.update(spec.get("env", {}))
+    argv: List[str] = list(spec["argv"])
+    if argv and _is_python(argv[0]):
+        argv = argv[1:]
+    if argv and argv[0] == "-u":
+        argv = argv[1:]
+    if len(argv) >= 2 and argv[0] == "-m":
+        sys.argv = argv[1:]
+        runpy.run_module(argv[1], run_name="__main__", alter_sys=True)
+        return
+    if len(argv) >= 2 and argv[0] == "-c":
+        sys.argv = ["-c"] + argv[2:]
+        exec(compile(argv[1], "<kf-standby>", "exec"), {"__name__": "__main__"})
+        return
+    if argv and argv[0].endswith(".py"):
+        sys.argv = argv
+        runpy.run_path(argv[0], run_name="__main__")
+        return
+    # not a python command: fall back to exec (warmth is lost, behavior
+    # is preserved)
+    os.execvpe(argv[0], argv, dict(os.environ))
+
+
+class StandbySlot:
+    """Watcher-side handle to one standby process."""
+
+    def __init__(self, proc, fifo: str):
+        self.proc = proc
+        self.fifo = fifo
+
+    @property
+    def alive(self) -> bool:
+        return self.proc.running
+
+    def activate(
+        self, env: dict, argv: List[str], name: str, rank: int,
+        wait: float = 2.0,
+    ) -> bool:
+        """Hand the standby its worker identity; False if it died (caller
+        falls back to a cold spawn). A just-spawned standby may not have
+        opened its FIFO yet (python exec in flight) — retry for up to
+        `wait` seconds while the process is alive, since even a not-yet-
+        warm standby beats a cold spawn."""
+        deadline = time.time() + wait
+        while True:
+            try:
+                fd = os.open(self.fifo, os.O_WRONLY | os.O_NONBLOCK)
+                break
+            except OSError as e:
+                if e.errno not in (errno.ENXIO, errno.ENOENT):
+                    raise
+                if not self.alive or time.time() >= deadline:
+                    self._unlink_fifo()
+                    return False
+                time.sleep(0.05)
+        try:
+            spec = json.dumps({"env": env, "argv": list(argv)}) + "\n"
+            os.write(fd, spec.encode())
+        except OSError:
+            return False
+        finally:
+            os.close(fd)
+            # single-shot: nothing opens this path again (the standby
+            # holds its read fd), so the file can go now
+            self._unlink_fifo()
+        self.proc.name = name
+        self.proc.rank = rank
+        return True
+
+    def _unlink_fifo(self) -> None:
+        try:
+            os.unlink(self.fifo)
+        except OSError:
+            pass
+
+
+class StandbyPool:
+    """Keeps up to `n` warm standbys; `take()` pops one for activation and
+    the caller refills asynchronously via `refill()` (Popen returns fast;
+    the replacement warms while training continues)."""
+
+    def __init__(self, n: int, logdir: str = "", quiet: bool = False,
+                 preload: str = ""):
+        import tempfile
+
+        from kungfu_tpu.runner.proc import WorkerProc
+
+        self._WorkerProc = WorkerProc
+        self.n = n
+        self.logdir = logdir
+        self.quiet = quiet
+        self.preload = preload
+        self._dir = tempfile.mkdtemp(prefix="kf-standby-")
+        self._seq = 0
+        self.slots: List[StandbySlot] = []
+
+    def refill(self) -> None:
+        live = []
+        for s in self.slots:
+            if s.alive:
+                live.append(s)
+            else:
+                s._unlink_fifo()
+        self.slots = live
+        while len(self.slots) < self.n:
+            fifo = os.path.join(self._dir, f"standby-{self._seq}.fifo")
+            os.mkfifo(fifo)
+            env = {"KF_STANDBY_FIFO": fifo}
+            if self.preload:
+                env["KF_STANDBY_PRELOAD"] = self.preload
+            p = self._WorkerProc(
+                name=f"standby-{self._seq}",
+                argv=[sys.executable, "-m", "kungfu_tpu.runner.standby"],
+                env=env,
+                rank=self._seq,
+                logdir=self.logdir or None,
+                quiet=self.quiet,
+            )
+            p.start()
+            self.slots.append(StandbySlot(p, fifo))
+            self._seq += 1
+
+    def take(self) -> Optional[StandbySlot]:
+        while self.slots:
+            s = self.slots.pop(0)
+            if s.alive:
+                return s
+            s._unlink_fifo()
+        return None
+
+    def kill_all(self) -> None:
+        import shutil
+
+        for s in self.slots:
+            s.proc.kill()
+        self.slots = []
+        shutil.rmtree(self._dir, ignore_errors=True)
+
+
+def main() -> None:
+    fifo = os.environ.get("KF_STANDBY_FIFO", "")
+    if not fifo:
+        print("kf-standby: KF_STANDBY_FIFO not set", file=sys.stderr)
+        sys.exit(2)
+    # open for reading BEFORE warming so the watcher's nonblocking
+    # open-for-write succeeds from the moment we exist
+    fd = os.open(fifo, os.O_RDONLY | os.O_NONBLOCK)
+    # warm imports: the bulk of cold-join latency
+    import numpy  # noqa: F401
+
+    import kungfu_tpu.api  # noqa: F401
+
+    for mod in filter(None, os.environ.get("KF_STANDBY_PRELOAD", "").split(",")):
+        try:
+            __import__(mod)
+        except ImportError as e:
+            print(f"kf-standby: preload {mod} failed: {e}", file=sys.stderr)
+    print("kf-standby: warm", flush=True)
+    # block until the activation line arrives
+    import select
+
+    buf = b""
+    while b"\n" not in buf:
+        select.select([fd], [], [])
+        chunk = os.read(fd, 65536)
+        if chunk:
+            buf += chunk
+        else:
+            # writer not connected yet (or closed without data): avoid a
+            # busy loop
+            time.sleep(0.05)
+    os.close(fd)
+    spec = json.loads(buf.split(b"\n", 1)[0].decode())
+    run_activated(spec)
+
+
+if __name__ == "__main__":
+    main()

@@ -75,6 +75,8 @@ class DebugServer:
 
 
 class Watcher:
+    REFILL_DELAY = 3.0  # seconds after an activation before warming a spare
+
     def __init__(self, args, cmd, self_host: str, strategy, config_server_url: str):
         self.args = args
         self.cmd = cmd
@@ -108,6 +110,48 @@ class Watcher:
             cap = max(1, getattr(args, "host_capacity", 0))
             self.chips_per_worker = max(1, n_dev // cap)
             self.slot_pool = SlotPool.of_size(n_dev)
+        # warm spares: pre-imported standby processes that turn elastic-join
+        # spawn+import (seconds of CPU) into a FIFO write
+        self.standby_pool = None
+        n_spares = getattr(args, "warm_spares", 0)
+        if n_spares > 0:
+            from kungfu_tpu.runner.standby import StandbyPool
+
+            self.standby_pool = StandbyPool(
+                n_spares,
+                logdir=getattr(args, "logdir", ""),
+                quiet=getattr(args, "quiet", False),
+                preload=getattr(args, "standby_preload", ""),
+            )
+            self.standby_pool.refill()
+        self._initial_done = False
+        self._refill_at: Optional[float] = None
+        # -w + -auto-recover composition: a worker that DIES (nonzero exit
+        # without a Stage removing it) triggers a reload at a shrunk
+        # cluster instead of stranding the survivors in blocked
+        # collectives (parity goal: monitored.go generalized to elastic
+        # membership — the preemptible-TPU-VM story)
+        self.auto_recover = bool(getattr(args, "auto_recover", ""))
+        self.failure_restarts = 0
+        self.last_stage: Optional[Stage] = None
+        self.hb_state = None
+        self.monitor = None
+        self.grace = 0.0
+        if self.auto_recover:
+            # the monitored-mode heartbeat server, composed into the
+            # elastic watcher: workers report begin/end/epoch so recovery
+            # carries REAL progress and hung (not just dead) workers are
+            # detected by the same grace rule
+            from kungfu_tpu.runner.monitored import (
+                HeartbeatState,
+                MonitorServer,
+                parse_duration,
+            )
+
+            self.hb_state = HeartbeatState()
+            self.monitor = MonitorServer(self.hb_state, port=0)
+            self.monitor.start()
+            self.grace = parse_duration(args.auto_recover)
 
     def debug_dump(self) -> dict:
         # runs on HTTP handler threads: snapshot under the state lock so a
@@ -178,6 +222,31 @@ class Watcher:
             self.config_server_url, version=stage.version, progress=stage.progress,
             device_slots=slots,
         )
+        if self.monitor is not None:
+            from kungfu_tpu.runner.monitored import MONITOR_ADDR_ENV
+
+            p.env[MONITOR_ADDR_ENV] = f"{self.self_host}:{self.monitor.port}"
+        # standbys serve post-initial joins only (at t0 a cold spawn is
+        # concurrent with everything else anyway, and the just-spawned
+        # standbys may not have opened their FIFOs yet)
+        if self.standby_pool is not None and self._initial_done:
+            slot = self.standby_pool.take()
+            if slot is not None:
+                if slot.activate(p.env, p.argv, p.name, p.rank):
+                    print(f"kfrun: warm standby activated as {p.name}",
+                          file=sys.stderr)
+                    with self._state_lock:
+                        self.current[w] = slot.proc
+                    # refill DEFERRED: a replacement standby's imports
+                    # would compete with the joiner for CPU during the
+                    # rebuild barrier — the critical path of the resize
+                    self._refill_at = time.monotonic() + self.REFILL_DELAY
+                    return
+                # unreachable fifo: the standby is dead or wedged — never
+                # reusable, don't leak it
+                print(f"kfrun: standby unreachable; cold spawning {p.name}",
+                      file=sys.stderr)
+                slot.proc.kill()
         p.start()
         with self._state_lock:
             self.current[w] = p
@@ -186,7 +255,17 @@ class Watcher:
         if self.slot_pool is not None and w in self._worker_slots:
             self.slot_pool.put(self._worker_slots.pop(w))
 
+    def _reset_heartbeats(self, stage: Stage) -> None:
+        """Any membership change invalidates heartbeat rank bookkeeping:
+        ranks are re-assigned by the new peer list, and a leaver killed
+        mid-batch would otherwise stay 'stuck' forever and get a HEALTHY
+        worker at its old rank killed later."""
+        if self.hb_state is not None:
+            self.hb_state.reset(stage.progress)
+
     def apply_delta(self, stage: Stage) -> None:
+        self.last_stage = stage
+        self._reset_heartbeats(stage)
         new_local = {w for w in stage.cluster.workers if w.host == self.self_host}
         with self._state_lock:
             old_local = set(self.current)
@@ -200,6 +279,8 @@ class Watcher:
 
     def apply_full(self, stage: Stage) -> None:
         """Reload mode: stop everything, restart from stage.progress."""
+        self.last_stage = stage
+        self._reset_heartbeats(stage)
         with self._state_lock:
             doomed = list(self.current.items())
             self.current.clear()
@@ -209,6 +290,106 @@ class Watcher:
         for w in stage.cluster.workers:
             if w.host == self.self_host:
                 self._spawn(w, stage)
+
+    def _dead_workers(self) -> List[PeerID]:
+        """Local workers that died WITHOUT a Stage removing them: exit
+        code != 0 while still a cluster member = a real failure (normal
+        completion exits 0, and leavers are moved to _gone first)."""
+        with self._state_lock:
+            return [
+                w for w, p in self.current.items()
+                if not p.running and p.proc.returncode not in (0, None)
+            ]
+
+    def _put_config(self, cluster: Cluster) -> None:
+        if not self.config_server_url:
+            return
+        import urllib.request
+
+        req = urllib.request.Request(
+            self.config_server_url, data=cluster.dumps().encode(), method="PUT"
+        )
+        try:
+            with urllib.request.urlopen(req, timeout=5) as resp:
+                resp.read()
+        except OSError as e:
+            print(f"kfrun: config-server PUT failed: {e}", file=sys.stderr)
+
+    def recover_from_failure(self, dead: List[PeerID]) -> None:
+        """Shrink the dead workers out and reload the survivors from the
+        last known progress. The recovery Stage is applied locally,
+        broadcast to every other runner's control endpoint, and published
+        to the config server so later elastic polls don't resize the
+        corpses back in."""
+        self.failure_restarts += 1
+        if self.failure_restarts > 10:
+            print("kfrun: too many failure recoveries, giving up", file=sys.stderr)
+            self.exit_code = 1
+            self.done.set()
+            return
+        base = self.last_stage
+        survivors = [w for w in base.cluster.workers if w not in set(dead)]
+        codes = {
+            str(w): (self.current[w].proc.returncode if w in self.current else "?")
+            for w in dead
+        }
+        print(
+            f"kfrun: workers {codes} died; reloading at size {len(survivors)}",
+            file=sys.stderr,
+        )
+        if not survivors:
+            self.exit_code = 1
+            self.done.set()
+            return
+        progress = base.progress
+        if self.hb_state is not None:
+            n_local = sum(
+                1 for w in base.cluster.workers if w.host == self.self_host
+            )
+            progress = max(progress, self.hb_state.min_epoch(n_local))
+        cluster = Cluster(runners=base.cluster.runners, workers=PeerList(survivors))
+        # version skewed by this runner's index so two hosts detecting
+        # failures in the same window mint DIFFERENT versions instead of
+        # colliding on max+1 with different clusters (which the diverged-
+        # digest safety check would abort the whole job over). Both reload
+        # stages then apply in version order; if each removed only its own
+        # corpse, the later one still carries the other corpse and the next
+        # detection round (restart cap 10) converges.
+        runners = list(base.cluster.runners)
+        self_idx = next(
+            (i for i, r in enumerate(runners) if r.host == self.self_host), 0
+        )
+        stage = Stage(
+            version=max(self.seen_versions) + 1 + self_idx,
+            progress=progress,
+            cluster=cluster,
+            reload=True,
+        )
+        self.seen_versions[stage.version] = stage.digest()
+        self.record_stage(stage)
+        self._put_config(cluster)
+        # fan the reload out to the other runners (their workers are
+        # blocked in collectives against the corpse)
+        others = [r for r in cluster.runners if r.host != self.self_host]
+        if others:
+            import json as _json
+
+            from kungfu_tpu.transport.client import Client
+
+            payload = _json.dumps({
+                "Version": stage.version,
+                "Progress": stage.progress,
+                "Cluster": cluster.to_json(),
+                "Reload": True,
+            }).encode()
+            cl = Client(PeerID(self.self_host, self.args.runner_port))
+            for r in others:
+                try:
+                    cl.send(r, "update", payload, ConnType.CONTROL)
+                except (ConnectionError, OSError) as e:
+                    print(f"kfrun: notify {r} failed: {e}", file=sys.stderr)
+            cl.close()
+        self.apply_full(stage)
 
     def run(self, initial: Stage) -> int:
         server = Server(PeerID(self.self_host, self.args.runner_port), use_unix=False)
@@ -222,6 +403,7 @@ class Watcher:
         idle_since: Optional[float] = None
         try:
             self.apply_delta(initial)
+            self._initial_done = True
             while not self.done.is_set():
                 try:
                     stage = self.stage_q.get(timeout=0.5)
@@ -233,17 +415,72 @@ class Watcher:
                     # concluding too early drops the reload and strands the
                     # cluster. Delta-mode exits stay prompt.
                     grace = 2.0 if self.args.elastic_mode == "reload" else 0.0
+                    if self.auto_recover:
+                        dead = self._dead_workers()
+                        if (
+                            not dead
+                            and self.hb_state is not None
+                            and self.last_stage is not None
+                        ):
+                            # hung (not dead) workers: same grace rule as
+                            # monitored mode; kill them so recovery treats
+                            # them as dead
+                            stuck = self.hb_state.stuck_ranks(self.grace)
+                            workers = self.last_stage.cluster.workers
+                            for r in stuck:
+                                if 0 <= r < len(workers):
+                                    w = workers[r]
+                                    with self._state_lock:
+                                        proc = self.current.get(w)
+                                    if proc is not None:
+                                        print(
+                                            f"kfrun: worker {w} stuck > "
+                                            f"{self.grace}s; killing",
+                                            file=sys.stderr,
+                                        )
+                                        proc.kill()
+                                        dead.append(w)
+                        if dead and any(
+                            p.running for p in self.current.values()
+                        ):
+                            # partial death: recover NOW (survivors are
+                            # stuck); a full death falls through to the
+                            # normal all-exited handling below, where
+                            # uniform nonzero exits also recover
+                            self.recover_from_failure(dead)
+                            continue
                     if self.current and all(not p.running for p in self.current.values()):
                         if idle_since is None:
                             idle_since = time.monotonic()
                         if time.monotonic() - idle_since >= grace:
                             codes = [p.proc.returncode for p in self.current.values()]
+                            if (
+                                self.auto_recover
+                                and any(c != 0 for c in codes)
+                                and self.last_stage is not None
+                                and any(
+                                    w.host != self.self_host
+                                    for w in self.last_stage.cluster.workers
+                                )
+                            ):
+                                # every local worker is gone but remote
+                                # hosts still train: shrink this host out
+                                # instead of abandoning them mid-collective
+                                self.recover_from_failure(self._dead_workers())
+                                idle_since = None
+                                continue
                             self.exit_code = 0 if all(c == 0 for c in codes) else 1
                             break
                     else:
                         idle_since = None
                     # reap detached workers
                     self._gone = [p for p in self._gone if p.running]
+                    if (
+                        self._refill_at is not None
+                        and time.monotonic() >= self._refill_at
+                    ):
+                        self._refill_at = None
+                        self.standby_pool.refill()
                     continue
                 idle_since = None
                 if stage.reload:
@@ -256,6 +493,10 @@ class Watcher:
                 p.kill()
             for p in self._gone:
                 p.kill()
+            if self.standby_pool is not None:
+                self.standby_pool.kill_all()
+            if self.monitor is not None:
+                self.monitor.stop()
             server.stop()
             if debug is not None:
                 debug.stop()

@@ -21,35 +21,165 @@ from kungfu_tpu.plan.peer import PeerID
 from kungfu_tpu.transport.message import ConnType, Flags, Message
 
 
-class _Rendezvous:
-    """A blocking mailbox per (src, name)."""
+class _Sink:
+    """A receiver-registered destination buffer. The transport thread
+    delivers a matching payload straight off the socket into `view`
+    (zero-copy receive, parity: WAIT_RECV_BUF / handler/collective.go
+    RecvInto)."""
+
+    __slots__ = ("view", "state", "flags")
+    WAITING, TAKEN, DONE, FAILED, CANCELLED = range(5)
+
+    def __init__(self, view: memoryview):
+        self.view = view
+        self.state = _Sink.WAITING
+        self.flags = Flags.NONE
+
+
+class _Box:
+    """Per-(src, name) mailbox with its own condition — a put wakes only
+    this key's waiters (one shared condition would thundering-herd every
+    in-flight chunk walk on every message)."""
+
+    __slots__ = ("cond", "msgs", "sinks", "waiters")
 
     def __init__(self):
-        self._cond = threading.Condition()
-        self._boxes: Dict[Tuple[PeerID, str], deque] = defaultdict(deque)
+        self.cond = threading.Condition()
+        self.msgs: deque = deque()
+        self.sinks: deque = deque()
+        self.waiters = 0
+
+    def idle(self) -> bool:
+        return not self.msgs and not self.sinks and self.waiters == 0
+
+
+class _Rendezvous:
+    """Blocking mailboxes per (src, name), with optional registered sinks."""
+
+    def __init__(self):
+        self._lock = threading.Lock()  # guards the box dict only
+        self._boxes: Dict[Tuple[PeerID, str], _Box] = {}
+
+    def _box(self, key) -> _Box:
+        with self._lock:
+            b = self._boxes.get(key)
+            if b is None:
+                b = self._boxes[key] = _Box()
+            return b
+
+    def _gc(self, key, box: _Box) -> None:
+        # names are version/chunk-tagged: drop drained mailboxes so long
+        # elastic runs don't accumulate dead keys
+        with self._lock:
+            if box.idle() and self._boxes.get(key) is box:
+                del self._boxes[key]
 
     def put(self, src: PeerID, msg: Message) -> None:
-        with self._cond:
-            self._boxes[(src, msg.name)].append(msg)
-            self._cond.notify_all()
+        key = (src, msg.name)
+        box = self._box(key)
+        with box.cond:
+            box.msgs.append(msg)
+            # notify_all: waiters include get() consumers AND get_into()
+            # sink-parkers whose predicates differ; per-key wakeups are 1-2
+            # threads, so this is cheap
+            box.cond.notify_all()
 
     def get(self, src: PeerID, name: str, timeout: Optional[float] = None) -> Message:
         key = (src, name)
-        with self._cond:
-            ok = self._cond.wait_for(lambda: len(self._boxes.get(key, ())) > 0, timeout)
-            if not ok:
-                raise TimeoutError(f"recv timeout: {name} from {src}")
-            box = self._boxes[key]
-            msg = box.popleft()
-            if not box:
-                # names are version/chunk-tagged: drop drained mailboxes so
-                # long elastic runs don't accumulate dead keys
-                del self._boxes[key]
-            return msg
+        box = self._box(key)
+        with box.cond:
+            box.waiters += 1
+            try:
+                ok = box.cond.wait_for(lambda: len(box.msgs) > 0, timeout)
+                if not ok:
+                    raise TimeoutError(f"recv timeout: {name} from {src}")
+                return box.msgs.popleft()
+            finally:
+                box.waiters -= 1
+                if box.idle():
+                    self._gc(key, box)
+
+    # -- zero-copy receive ------------------------------------------------
+
+    def take_sink(self, src: PeerID, name: str, nbytes: int) -> Optional[_Sink]:
+        """Transport side: claim a waiting sink of exactly `nbytes`, or None
+        (fall back to a buffered Message)."""
+        key = (src, name)
+        with self._lock:
+            box = self._boxes.get(key)
+        if box is None:
+            return None
+        with box.cond:
+            for s in box.sinks:
+                if s.state == _Sink.WAITING and s.view.nbytes == nbytes:
+                    s.state = _Sink.TAKEN
+                    return s
+            return None
+
+    def finish_sink(self, src: PeerID, name: str, sink: _Sink, flags: Flags, ok: bool) -> None:
+        key = (src, name)
+        box = self._box(key)
+        with box.cond:
+            sink.flags = flags
+            sink.state = _Sink.DONE if ok else _Sink.FAILED
+            box.cond.notify_all()
+        # pathological path: the receiver gave up mid-fill and its box was
+        # GC'd; don't let the re-created box linger
+        self._gc(key, box)
+
+    def get_into(
+        self, src: PeerID, name: str, view: memoryview, timeout: Optional[float]
+    ) -> Tuple[Optional[Message], bool]:
+        """Receive (src, name), preferring direct delivery into `view`.
+
+        Returns (msg, filled): filled=True means the payload is in `view`
+        and msg is None; otherwise msg is a buffered Message (sender raced
+        registration, or size mismatch). On timeout with the sink mid-fill
+        (TAKEN), the buffer must NOT be reused — the caller leaks it."""
+        key = (src, name)
+        box = self._box(key)
+        sink = _Sink(view)
+        with box.cond:
+            box.waiters += 1
+            try:
+                if box.msgs:
+                    return box.msgs.popleft(), False
+                box.sinks.append(sink)
+
+                def ready():
+                    return sink.state in (_Sink.DONE, _Sink.FAILED) or box.msgs
+
+                ok = box.cond.wait_for(ready, timeout)
+                if sink.state == _Sink.TAKEN:
+                    # transport thread is writing into view RIGHT NOW; wait
+                    # for it to finish rather than handing a live buffer back
+                    box.cond.wait_for(
+                        lambda: sink.state in (_Sink.DONE, _Sink.FAILED), 30.0
+                    )
+                if sink.state == _Sink.DONE:
+                    box.sinks.remove(sink)
+                    return None, True
+                if sink.state == _Sink.FAILED:
+                    box.sinks.remove(sink)
+                    raise ConnectionError(f"recv failed mid-frame: {name} from {src}")
+                if sink.state == _Sink.TAKEN:
+                    box.sinks.remove(sink)
+                    raise TimeoutError(f"recv stuck mid-frame: {name} from {src}")
+                # WAITING: nothing touched the buffer
+                sink.state = _Sink.CANCELLED
+                box.sinks.remove(sink)
+                if not ok:
+                    raise TimeoutError(f"recv timeout: {name} from {src}")
+                return box.msgs.popleft(), False
+            finally:
+                box.waiters -= 1
+                if box.idle():
+                    self._gc(key, box)
 
 
 class CollectiveEndpoint:
-    """Named rendezvous for graph-walk collectives."""
+    """Named rendezvous for graph-walk collectives, with zero-copy sink
+    delivery when the receiver is already waiting."""
 
     def __init__(self):
         self._rdv = _Rendezvous()
@@ -59,6 +189,19 @@ class CollectiveEndpoint:
 
     def recv(self, src: PeerID, name: str, timeout: Optional[float] = None) -> Message:
         return self._rdv.get(src, name, timeout)
+
+    def recv_into(
+        self, src: PeerID, name: str, view: memoryview, timeout: Optional[float] = None
+    ) -> Tuple[Optional[Message], bool]:
+        """(msg, filled) — see _Rendezvous.get_into."""
+        return self._rdv.get_into(src, name, view, timeout)
+
+    # transport-side hooks (Server streaming path)
+    def take_sink(self, src: PeerID, name: str, nbytes: int):
+        return self._rdv.take_sink(src, name, nbytes)
+
+    def finish_sink(self, src: PeerID, name: str, sink, flags: Flags, ok: bool) -> None:
+        self._rdv.finish_sink(src, name, sink, flags, ok)
 
 
 class QueueEndpoint:
@@ -87,25 +230,46 @@ class ControlEndpoint:
 
 
 class P2PEndpoint:
-    """Request/response over a versioned blob store.
+    """Request/response over the blob stores (flat + versioned).
 
-    Parity: srcs/go/rchannel/handler/p2p.go:13-121. Requests name a blob
-    (and optionally a version); the remote endpoint reads it from its store
-    and sends it back flagged IS_RESPONSE (REQUEST_FAILED when absent).
+    Parity: srcs/go/rchannel/handler/p2p.go:13-121. A request names a blob,
+    optionally with a version selector (``name@#<version>`` or
+    ``name@#latest`` on the wire); versioned requests are served from a
+    VersionedStore with a bounded GC window, so a reader always gets a
+    CONSISTENT published snapshot while the writer publishes the next
+    version — the reference's actual consistency contract for
+    PairAveraging. Responses come back flagged IS_RESPONSE
+    (REQUEST_FAILED when absent).
     """
 
-    def __init__(self, store, client, self_id: PeerID):
+    VSEP = "@#"  # version selector separator in wire names
+
+    def __init__(self, store, client, self_id: PeerID, vstore=None):
+        from kungfu_tpu.store.versioned import VersionedStore
+
         self.store = store
+        self.vstore = vstore if vstore is not None else VersionedStore(window=3)
         self.client = client
         self.self_id = self_id
         self._rdv = _Rendezvous()
+
+    def _lookup(self, wire_name: str) -> Optional[bytes]:
+        name, sep, selector = wire_name.partition(self.VSEP)
+        if not sep:
+            return self.store.get(wire_name)
+        if selector == "latest":
+            return self.vstore.get_latest(name)
+        try:
+            return self.vstore.get(int(selector), name)
+        except ValueError:
+            return None
 
     def handle(self, src: PeerID, msg: Message) -> None:
         if msg.flags & Flags.IS_RESPONSE:
             self._rdv.put(src, msg)
             return
         # incoming request: look up blob, respond
-        data = self.store.get(msg.name)
+        data = self._lookup(msg.name)
         if data is None:
             self.client.send(
                 src, msg.name, b"", ConnType.PEER_TO_PEER,
@@ -116,13 +280,27 @@ class P2PEndpoint:
                 src, msg.name, data, ConnType.PEER_TO_PEER, Flags.IS_RESPONSE
             )
 
-    def request(self, peer: PeerID, name: str, timeout: float = 30.0) -> Optional[bytes]:
-        """Fetch `name` from peer's store; None if the peer doesn't have it."""
-        self.client.send(peer, name, b"", ConnType.PEER_TO_PEER, Flags.NONE)
-        msg = self._rdv.get(peer, name, timeout)
+    def request(
+        self,
+        peer: PeerID,
+        name: str,
+        timeout: float = 30.0,
+        version: "Optional[int | str]" = None,
+    ) -> Optional[bytes]:
+        """Fetch `name` from peer's store; None if the peer doesn't have
+        it. version=None targets the flat store; an int (or "latest")
+        targets the peer's versioned store."""
+        wire = name if version is None else f"{name}{self.VSEP}{version}"
+        self.client.send(peer, wire, b"", ConnType.PEER_TO_PEER, Flags.NONE)
+        msg = self._rdv.get(peer, wire, timeout)
         if msg.flags & Flags.REQUEST_FAILED:
             return None
         return msg.data
 
     def save(self, name: str, data: bytes) -> None:
         self.store.put(name, data)
+
+    def save_version(self, version: int, name: str, data: bytes) -> None:
+        """Publish an immutable (version, blob); versions beyond the GC
+        window (3, parity p2p.go:11) are dropped."""
+        self.vstore.put(version, name, data)

@@ -16,6 +16,7 @@ import dataclasses
 import enum
 import socket
 import struct
+from typing import Tuple
 
 MAGIC = 0x4B465450  # "KFTP"
 
@@ -38,7 +39,7 @@ class Flags(enum.IntFlag):
 @dataclasses.dataclass
 class Message:
     name: str
-    data: bytes
+    data: "bytes | bytearray | memoryview"  # any buffer; np.frombuffer-able
     flags: Flags = Flags.NONE
 
 
@@ -50,16 +51,22 @@ _HEADER = struct.Struct("<IBHI")  # magic, conn_type, src_port, token
 _FRAME = struct.Struct("<III")  # name_len, flags, data_len
 
 
-def _recv_exact(sock: socket.socket, n: int) -> bytes:
-    buf = bytearray(n)
-    view = memoryview(buf)
+def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    n = len(view)
     got = 0
     while got < n:
         r = sock.recv_into(view[got:], n - got)
         if r == 0:
             raise ConnectionError("peer closed connection")
         got += r
-    return bytes(buf)
+
+
+def _recv_exact(sock: socket.socket, n: int) -> bytearray:
+    # bytearray, not bytes: spares the final copy; every consumer
+    # (np.frombuffer, .decode, struct.unpack) takes any buffer
+    buf = bytearray(n)
+    _recv_exact_into(sock, memoryview(buf))
+    return buf
 
 
 def send_header(sock: socket.socket, conn_type: ConnType, src_host: str, src_port: int, token: int) -> None:
@@ -89,14 +96,30 @@ def recv_ack(sock: socket.socket) -> int:
 
 def send_message(sock: socket.socket, msg: Message) -> None:
     name_b = msg.name.encode()
-    sock.sendall(_FRAME.pack(len(name_b), int(msg.flags), len(msg.data)))
-    sock.sendall(name_b)
-    if msg.data:
+    data_len = nbytes_of(msg.data)
+    # one syscall for frame+name; payload separate (never copy it)
+    sock.sendall(_FRAME.pack(len(name_b), int(msg.flags), data_len) + name_b)
+    if data_len:
         sock.sendall(msg.data)
 
 
-def recv_message(sock: socket.socket) -> Message:
+def nbytes_of(data) -> int:
+    """Byte length of any buffer (len() of a typed memoryview counts
+    elements, not bytes)."""
+    if isinstance(data, memoryview):
+        return data.nbytes
+    return len(data)
+
+
+def recv_frame_header(sock: socket.socket) -> Tuple[str, Flags, int]:
+    """Read frame header + name, leaving the payload unread on the socket
+    so the caller can deliver it straight into a registered buffer."""
     name_len, flags, data_len = _FRAME.unpack(_recv_exact(sock, _FRAME.size))
     name = _recv_exact(sock, name_len).decode()
+    return name, Flags(flags), data_len
+
+
+def recv_message(sock: socket.socket) -> Message:
+    name, flags, data_len = recv_frame_header(sock)
     data = _recv_exact(sock, data_len) if data_len else b""
-    return Message(name=name, data=data, flags=Flags(flags))
+    return Message(name=name, data=data, flags=flags)

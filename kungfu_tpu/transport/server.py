@@ -20,6 +20,9 @@ from kungfu_tpu.plan.peer import PeerID
 from kungfu_tpu.transport.message import (
     ConnType,
     Message,
+    _recv_exact,
+    _recv_exact_into,
+    recv_frame_header,
     recv_header,
     recv_message,
     send_ack,
@@ -157,11 +160,37 @@ class Server:
             from kungfu_tpu.monitor import net as _net
 
             monitor = _net.get_monitor() if _net.enabled() else None
-            while not self._stopped.is_set():
-                msg = recv_message(conn)
-                if monitor is not None:
-                    monitor.received(src, len(msg.data))
-                handler(src, msg)
+            # Zero-copy receive: when the registered endpoint exposes the
+            # sink protocol (CollectiveEndpoint), read the frame header
+            # first and, if a receiver is already parked on (src, name)
+            # with a matching buffer, deliver the payload straight off the
+            # socket into it (parity: WAIT_RECV_BUF / RecvInto,
+            # handler/collective.go:34-65).
+            endpoint = getattr(handler, "__self__", None)
+            take_sink = getattr(endpoint, "take_sink", None)
+            if take_sink is None:
+                while not self._stopped.is_set():
+                    msg = recv_message(conn)
+                    if monitor is not None:
+                        monitor.received(src, len(msg.data))
+                    handler(src, msg)
+            else:
+                finish_sink = endpoint.finish_sink
+                while not self._stopped.is_set():
+                    name, flags, data_len = recv_frame_header(conn)
+                    sink = take_sink(src, name, data_len) if data_len else None
+                    if sink is not None:
+                        try:
+                            _recv_exact_into(conn, sink.view)
+                        except BaseException:
+                            finish_sink(src, name, sink, flags, ok=False)
+                            raise
+                        finish_sink(src, name, sink, flags, ok=True)
+                    else:
+                        data = _recv_exact(conn, data_len) if data_len else b""
+                        handler(src, Message(name=name, data=data, flags=flags))
+                    if monitor is not None:
+                        monitor.received(src, data_len)
         except (ConnectionError, OSError):
             pass
         except (ValueError, UnicodeDecodeError, struct.error):

@@ -1,0 +1,107 @@
+"""Cached-thread executor and reusable byte-buffer pool.
+
+Capability parity: the reference engine runs every graph-walk send/recv in
+a goroutine and recycles payload buffers through a pool
+(srcs/go/rchannel/connection/byte_slice_pool.go). Python threads are far
+more expensive to create than goroutines, so the collective hot path must
+not spawn a fresh thread per peer x chunk (the round-3 engine did; it was
+the dominant cost at small message sizes).
+
+`CachedThreadPool.submit` never blocks waiting for a free worker — an idle
+parked thread is reused, otherwise a new one spawns (goroutine semantics;
+a bounded pool would deadlock on nested _par fan-outs). Idle workers park
+for `idle_ttl` seconds, then exit, so a big elastic cluster epoch doesn't
+pin threads forever.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import defaultdict, deque
+from typing import Callable, Deque, Dict, List, Optional
+
+
+class _Worker:
+    __slots__ = ("task", "cond", "dead")
+
+    def __init__(self):
+        self.cond = threading.Condition()
+        self.task: Optional[Callable[[], None]] = None
+        self.dead = False
+
+
+class CachedThreadPool:
+    def __init__(self, idle_ttl: float = 30.0):
+        self._idle: Deque[_Worker] = deque()
+        self._lock = threading.Lock()
+        self._ttl = idle_ttl
+
+    def submit(self, fn: Callable[[], None]) -> None:
+        """Run fn on a cached (or new) daemon thread; never blocks."""
+        with self._lock:
+            while self._idle:
+                w = self._idle.pop()
+                with w.cond:
+                    if w.dead:
+                        continue
+                    w.task = fn
+                    w.cond.notify()
+                return
+        w = _Worker()
+        w.task = fn
+        threading.Thread(target=self._loop, args=(w,), daemon=True).start()
+
+    def _loop(self, w: _Worker) -> None:
+        while True:
+            task = w.task
+            w.task = None
+            try:
+                task()
+            except BaseException:  # noqa: BLE001 - submit() wraps errors
+                pass
+            with self._lock:
+                self._idle.append(w)
+            with w.cond:
+                if not w.cond.wait_for(lambda: w.task is not None, self._ttl):
+                    w.dead = True
+                    return
+
+
+_POOL = CachedThreadPool()
+
+
+def get_pool() -> CachedThreadPool:
+    return _POOL
+
+
+class BufferPool:
+    """Reusable bytearray pool keyed by exact size (parity:
+    byte_slice_pool.go). Collectives re-receive the same chunk sizes every
+    step, so exact-size bins hit ~always; unreturned buffers (timed-out
+    receives whose writer may still be mid-fill) are simply leaked."""
+
+    def __init__(self, max_per_size: int = 16):
+        self._bins: Dict[int, List[bytearray]] = defaultdict(list)
+        self._lock = threading.Lock()
+        self._max = max_per_size
+
+    def get(self, nbytes: int) -> bytearray:
+        with self._lock:
+            b = self._bins.get(nbytes)
+            if b:
+                return b.pop()
+        return bytearray(nbytes)
+
+    def put(self, buf: bytearray) -> None:
+        with self._lock:
+            b = self._bins[len(buf)]
+            if len(b) < self._max:
+                b.append(buf)
+
+
+_BUFFERS = BufferPool()
+
+
+def get_buffer_pool() -> BufferPool:
+    return _BUFFERS

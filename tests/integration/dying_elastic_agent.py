@@ -1,0 +1,43 @@
+"""Agent for the elastic-watch failure-recovery e2e: trains with
+ElasticState under kfrun -w -auto-recover; one worker SIGKILLs itself
+mid-train at the initial size, and training must complete at the shrunk
+size with carried progress."""
+
+import os
+import signal
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.elastic.state import ElasticState
+from kungfu_tpu.runner.monitored import send_heartbeat
+
+TOTAL = 24
+KILL_AT = 8
+
+es = ElasticState(max_progress=TOTAL)
+rank, size = api.current_rank(), api.cluster_size()
+print(f"agent up rank={rank} size={size} progress={es.progress}", flush=True)
+
+while not es.stopped():
+    with es.scope():
+        step = es.progress
+        rank, size = api.current_rank(), api.cluster_size()
+        send_heartbeat("begin", rank)
+        out = api.all_reduce_array(np.ones(2, np.float32), name=f"s{step}")
+        assert out[0] == size, (out, size)
+        send_heartbeat("end", rank)
+        if step == KILL_AT and size == 3 and rank == 2:
+            print("agent: rank 2 dying (SIGKILL)", flush=True)
+            os.kill(os.getpid(), signal.SIGKILL)
+        send_heartbeat("epoch", rank)
+        es.end(1)
+
+print(
+    f"agent done rank={api.current_rank()} size={api.cluster_size()} "
+    f"progress={es.progress} reason={es.stop_reason}",
+    flush=True,
+)

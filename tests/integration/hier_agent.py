@@ -1,0 +1,72 @@
+"""Agent for the hierarchical (multi-world) S-SGD e2e: each kfrun worker
+owns a 4-device CPU jax world; gradient sync is in-world pmean + host
+cross-world allreduce. Prints the final params as hex so the test can
+compare worlds bit-for-bit against a single-world 8-device run.
+
+All constants are dyadic rationals with few mantissa bits so the two
+worlds stay bit-identical to each other; vs the flat 8-way reference the
+hierarchical association ((4+4)/2 vs /8) may differ by reassociation
+rounding of ~1 ULP once squared-error terms fill the mantissa."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+STEPS = 3
+
+
+def build():
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    w1 = jnp.array((np.arange(16).reshape(4, 4) % 5 - 2), jnp.float32) / 8
+    w2 = jnp.array((np.arange(8).reshape(4, 2) % 3 - 1), jnp.float32) / 4
+    params = {"w1": w1, "w2": w2}
+    x = jnp.array((np.arange(32).reshape(8, 4) % 7 - 3), jnp.float32) / 2
+    t = jnp.array((np.arange(16).reshape(8, 2) % 4 - 2), jnp.float32)
+
+    def loss_fn(params, batch):
+        xb, tb = batch
+        h = jnp.maximum(xb @ params["w1"], 0.0)
+        y = h @ params["w2"]
+        return jnp.mean((y - tb) ** 2)
+
+    opt = optax.sgd(0.25)
+    return params, opt, (x, t), loss_fn
+
+
+def final_params_hex(params):
+    import jax
+
+    leaves = jax.tree.leaves(jax.device_get(params))
+    return ";".join(bytes(l.tobytes()).hex() for l in leaves)
+
+
+def main():
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+    jax.config.update("jax_num_cpu_devices", 4)
+
+    from kungfu_tpu import api
+    from kungfu_tpu.ops.hierarchical import make_hier_train_step
+    from kungfu_tpu.parallel import make_mesh
+
+    rank = api.current_rank()
+    assert api.cluster_size() == 2
+    params, opt, (x, t), loss_fn = build()
+    lo, hi = rank * 4, (rank + 1) * 4
+    local = (x[lo:hi], t[lo:hi])
+    mesh = make_mesh({"dp": 4})
+    step = make_hier_train_step(loss_fn, opt, mesh)
+    opt_state = opt.init(params)
+    for _ in range(STEPS):
+        params, opt_state, loss = step(params, opt_state, local)
+    print(f"HIER rank={rank} loss={float(loss):.6f} "
+          f"params={final_params_hex(params)}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

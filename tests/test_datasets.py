@@ -1,0 +1,95 @@
+"""idx/npz dataset helpers (parity: v1/helpers/{idx,mnist,cifar}.py)."""
+
+import gzip
+import pickle
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.datasets import (
+    load_cifar10,
+    load_mnist,
+    load_npz,
+    read_idx,
+    write_idx,
+)
+
+
+@pytest.mark.parametrize("dtype", [np.uint8, np.int8, np.int16, np.int32,
+                                   np.float32, np.float64])
+def test_idx_roundtrip(tmp_path, dtype):
+    arr = (np.arange(24).reshape(2, 3, 4) % 120).astype(dtype)
+    p = str(tmp_path / "a.idx")
+    write_idx(p, arr)
+    out = read_idx(p)
+    assert out.dtype == np.dtype(dtype).newbyteorder("=")
+    np.testing.assert_array_equal(out, arr)
+
+
+def test_idx_gzip_roundtrip(tmp_path):
+    arr = np.arange(10, dtype=np.uint8)
+    p = str(tmp_path / "a.idx.gz")
+    write_idx(p, arr)
+    with gzip.open(p) as f:
+        assert f.read(4) == bytes([0, 0, 0x08, 1])
+    np.testing.assert_array_equal(read_idx(p), arr)
+
+
+def test_idx_rejects_garbage(tmp_path):
+    p = tmp_path / "bad.idx"
+    p.write_bytes(b"\x01\x02\x03\x04junk")
+    with pytest.raises(ValueError, match="not an idx"):
+        read_idx(str(p))
+    p.write_bytes(bytes([0, 0, 0x08, 1]) + (5).to_bytes(4, "big") + b"ab")
+    with pytest.raises(ValueError, match="truncated"):
+        read_idx(str(p))
+
+
+def _write_mnist(tmp_path, n=6, gz=False):
+    suffix = ".gz" if gz else ""
+    rng = np.random.RandomState(0)
+    imgs = rng.randint(0, 255, (n, 28, 28)).astype(np.uint8)
+    labels = rng.randint(0, 10, (n,)).astype(np.uint8)
+    write_idx(str(tmp_path / f"train-images-idx3-ubyte{suffix}"), imgs)
+    write_idx(str(tmp_path / f"train-labels-idx1-ubyte{suffix}"), labels)
+    write_idx(str(tmp_path / f"t10k-images-idx3-ubyte{suffix}"), imgs[:2])
+    write_idx(str(tmp_path / f"t10k-labels-idx1-ubyte{suffix}"), labels[:2])
+    return imgs, labels
+
+
+@pytest.mark.parametrize("gz", [False, True])
+def test_load_mnist(tmp_path, gz):
+    imgs, labels = _write_mnist(tmp_path, gz=gz)
+    d = load_mnist(str(tmp_path))
+    assert d["train_images"].shape == (6, 784)
+    assert d["train_images"].dtype == np.float32
+    assert d["train_images"].max() <= 1.0
+    np.testing.assert_array_equal(d["train_labels"], labels.astype(np.int32))
+    assert d["test_images"].shape == (2, 784)
+
+
+def test_load_mnist_missing(tmp_path):
+    with pytest.raises(FileNotFoundError):
+        load_mnist(str(tmp_path))
+
+
+def test_load_cifar10_pickle_batches(tmp_path):
+    rng = np.random.RandomState(1)
+    for i in range(1, 6):
+        data = rng.randint(0, 255, (4, 3072)).astype(np.uint8)
+        with open(tmp_path / f"data_batch_{i}", "wb") as f:
+            pickle.dump({b"data": data, b"labels": list(range(4))}, f)
+    with open(tmp_path / "test_batch", "wb") as f:
+        pickle.dump({b"data": rng.randint(0, 255, (2, 3072)).astype(np.uint8),
+                     b"labels": [1, 2]}, f)
+    tx, ty, vx, vy = load_cifar10(str(tmp_path))
+    assert tx.shape == (20, 32, 32, 3) and tx.dtype == np.float32
+    assert ty.shape == (20,) and vx.shape == (2, 32, 32, 3)
+    np.testing.assert_array_equal(vy, [1, 2])
+
+
+def test_load_npz(tmp_path):
+    p = str(tmp_path / "d.npz")
+    np.savez(p, x=np.ones((3, 2)), y=np.arange(3))
+    x, y = load_npz(p)
+    assert x.shape == (3, 2) and y.tolist() == [0, 1, 2]

@@ -144,3 +144,105 @@ def test_pair_averaging_single_worker_fallback():
         np.testing.assert_allclose(np.asarray(new_params["w"]), [0.9], rtol=1e-6)
     finally:
         p.stop()
+
+
+def test_pair_averaging_bf16_lossless(peer_pair):
+    """bf16 params must exchange losslessly: the wire blob is the packed
+    leaves (raw bytes + dtype header), not an f32 flatten (ADVICE r3 /
+    VERDICT r3 weak #4)."""
+    from kungfu_tpu.base.serialize import pack_leaves, unpack_leaves
+    from kungfu_tpu.optimizers.pair_averaging import _pack_host
+
+    p0, p1 = peer_pair
+    base = optax.sgd(0.0)
+    params = {
+        "w": jnp.arange(7, dtype=jnp.bfloat16) / 3,
+        "b": jnp.array([1.5, -2.25], jnp.float64)
+        if jax.config.jax_enable_x64
+        else jnp.array([1.5, -2.25], jnp.float32),
+    }
+    pa0 = PairAveraging(base, peer=p0)
+    pa1 = PairAveraging(base, peer=p1)
+
+    done = []
+
+    def run(pa, peer):
+        st = pa.init(params)
+        done.append(True)
+
+    t0 = threading.Thread(target=run, args=(pa0, p0))
+    t1 = threading.Thread(target=run, args=(pa1, p1))
+    t0.start(); t1.start(); t0.join(30); t1.join(30)
+    assert len(done) == 2
+
+    # wire bytes are exactly the packed leaves, dtypes intact
+    blob = p0.p2p.request(
+        p1.config.peers[1], pa0.blob, timeout=10, version="latest"
+    )
+    assert bytes(blob) == bytes(_pack_host(params))
+    leaves = unpack_leaves(bytes(blob), 2)
+    by_dtype = {str(l.dtype): l for l in leaves}
+    assert "bfloat16" in by_dtype
+    np.testing.assert_array_equal(
+        np.asarray(by_dtype["bfloat16"]),
+        np.asarray(jax.device_get(params["w"])),
+    )
+
+    # a full averaging step round-trips without dtype loss (identical
+    # models: average must be bit-identical to the input)
+    grads = jax.tree.map(jnp.zeros_like, params)
+    st = base.init(params)
+    new_params, _ = pa0.step(params, st, grads)
+    assert new_params["w"].dtype == jnp.bfloat16
+    np.testing.assert_array_equal(
+        np.asarray(jax.device_get(new_params["w"])),
+        np.asarray(jax.device_get(params["w"])),
+    )
+
+
+def test_versioned_p2p_requests(peer_pair):
+    """VersionedStore serves the live p2p path: exact-version and latest
+    requests round-trip; GC window drops old versions; concurrent
+    publish/request never yields a torn or vanished blob (parity:
+    handler/p2p.go:13-121)."""
+    p0, p1 = peer_pair
+    target = p1.config.peers[0]  # p0's own id, as seen by p1
+
+    for v in range(5):
+        p0.p2p.save_version(v, "m", f"model-v{v}".encode())
+    # exact versions inside the window (3)
+    assert bytes(p1.p2p.request(target, "m", version=4)) == b"model-v4"
+    assert bytes(p1.p2p.request(target, "m", version=2)) == b"model-v2"
+    # GC'd version + unknown name fail cleanly
+    assert p1.p2p.request(target, "m", version=0) is None
+    assert p1.p2p.request(target, "nope", version="latest") is None
+    assert bytes(p1.p2p.request(target, "m", version="latest")) == b"model-v4"
+    # flat store unaffected
+    p0.p2p.save("flat", b"plain")
+    assert bytes(p1.p2p.request(target, "flat")) == b"plain"
+
+    # concurrent writer/reader: every fetched blob is a complete published
+    # version, never torn, never missing
+    stop = threading.Event()
+    errs = []
+
+    def writer():
+        v = 5
+        while not stop.is_set():
+            p0.p2p.save_version(v, "m", b"%08d" % v * 128)
+            v += 1
+
+    def reader():
+        try:
+            for _ in range(50):
+                blob = bytes(p1.p2p.request(target, "m", version="latest"))
+                # a consistent snapshot is one 8-byte version token x 128
+                assert blob is not None and len(blob) == 8 * 128
+                assert blob == blob[:8] * 128, blob[:32]
+        except Exception as e:  # noqa: BLE001
+            errs.append(e)
+
+    w = threading.Thread(target=writer, daemon=True)
+    r = threading.Thread(target=reader)
+    w.start(); r.start(); r.join(60); stop.set(); w.join(10)
+    assert not errs, errs

@@ -62,8 +62,10 @@ def test_all_strategies_span(strategy, peers):
 
 
 def test_auto_select():
-    assert st.auto_select(make_peers(("a", 4))) == Strategy.STAR
-    assert st.auto_select(make_peers(("a", 2), ("b", 2))) == Strategy.BINARY_TREE_STAR
+    # multi-root striping defaults (bandwidth: no single-root funnel)
+    assert st.auto_select(make_peers(("a", 4))) == Strategy.CLIQUE
+    assert st.auto_select(make_peers(("a", 2))) == Strategy.STAR
+    assert st.auto_select(make_peers(("a", 2), ("b", 2))) == Strategy.MULTI_BINARY_TREE_STAR
 
 
 def test_multi_root_strategy_counts():
