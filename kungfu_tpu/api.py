@@ -92,6 +92,38 @@ def group_all_reduce_arrays(
     return [o.reshape(x.shape) for o, x in zip(outs, xs)]
 
 
+def broadcast_array(x: np.ndarray, root: int = 0, name: str = "user") -> np.ndarray:
+    """Host-plane broadcast from `root` (arbitrary roots, parity: the
+    reference's Broadcast op)."""
+    flat = np.ascontiguousarray(x).reshape(-1)
+    out = np.empty_like(flat)
+    if current_rank() == root:
+        np.copyto(out, flat)
+    w = Workspace(send=flat, recv=out, op=ReduceOp.SUM,
+                  name=f"kungfu::user::bcast:{name}")
+    get_default_peer().current_session().broadcast(w, root=root)
+    return out.reshape(x.shape)
+
+
+def gather_arrays(x: np.ndarray, root: int = 0, name: str = "user"):
+    """Host-plane gather of equal-shaped contributions to `root`; returns
+    the (size, *x.shape) stack at the root, None elsewhere (parity:
+    Gather, arbitrary roots)."""
+    sess = get_default_peer().current_session()
+    flat = np.ascontiguousarray(x).reshape(-1)
+    recv = (
+        np.empty(flat.size * sess.size, flat.dtype)
+        if sess.rank == root
+        else np.empty(0, flat.dtype)
+    )
+    w = Workspace(send=flat, recv=recv, op=ReduceOp.SUM,
+                  name=f"kungfu::user::gather:{name}")
+    sess.gather(w, root=root)
+    if sess.rank != root:
+        return None
+    return recv.reshape((sess.size,) + x.shape)
+
+
 def all_reduce_int_max(x: int) -> int:
     out = all_reduce_array(np.array([x], np.int64), ReduceOp.MAX, "int-max")
     return int(out[0])

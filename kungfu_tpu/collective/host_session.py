@@ -273,11 +273,35 @@ class HostSession:
     def local_broadcast(self, w: Workspace) -> None:
         self._run_graphs(w, [self.local_strategies[0].bcast_graph])
 
-    def reduce(self, w: Workspace) -> None:
-        self._run_graphs(w, [self.global_strategies[0].reduce_graph])
+    def reduce(self, w: Workspace, root: int = 0) -> None:
+        """Reduce to `root` (parity: runGraphs with a reduce graph; the
+        reference's Reduce takes arbitrary roots). Root 0 walks the
+        configured strategy; other roots use a root-specific star."""
+        if root == 0:
+            self._run_graphs(w, [self.global_strategies[0].reduce_graph])
+        else:
+            self._check_root(root)
+            from kungfu_tpu.plan import topology as _topo
 
-    def broadcast(self, w: Workspace) -> None:
-        self._run_graphs(w, [self.global_strategies[0].bcast_graph])
+            g = _topo.gen_default_reduce_graph(
+                _topo.gen_star_bcast_graph(self.size, root)
+            )
+            self._run_graphs(w, [g])
+
+    def broadcast(self, w: Workspace, root: int = 0) -> None:
+        if root == 0:
+            self._run_graphs(w, [self.global_strategies[0].bcast_graph])
+        else:
+            self._check_root(root)
+            from kungfu_tpu.plan import topology as _topo
+
+            self._run_graphs(
+                w, [_topo.gen_star_bcast_graph(self.size, root)]
+            )
+
+    def _check_root(self, root: int) -> None:
+        if not 0 <= root < self.size:
+            raise ValueError(f"root {root} outside cluster of {self.size}")
 
     def subset_all_reduce(self, fathers: Sequence[int], w: Workspace) -> None:
         sl = st.from_forest_array(list(fathers))
@@ -353,13 +377,14 @@ class HostSession:
         )
         return recv.tobytes()
 
-    def gather(self, w: Workspace) -> None:
-        """Rank 0 receives everyone's send buffer into recv (rank-major);
-        parity: runGather (session.go:195-221). Handles unequal per-peer
-        counts: the wire framing carries each message's true length, so the
-        root lays contributions out by their actual sizes (the reference
-        relies on the same message framing)."""
-        root = 0
+    def gather(self, w: Workspace, root: int = 0) -> None:
+        """`root` receives everyone's send buffer into recv (rank-major);
+        parity: runGather (session.go:195-221), arbitrary roots like the
+        reference's Gather. Handles unequal per-peer counts: the wire
+        framing carries each message's true length, so the root lays
+        contributions out by their actual sizes (the reference relies on
+        the same message framing)."""
+        self._check_root(root)
         if self.rank != root:
             self.client.send(
                 self.peers[root], w.name, _buf(w.send), ConnType.COLLECTIVE

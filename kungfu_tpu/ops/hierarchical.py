@@ -75,12 +75,20 @@ class CrossSliceReducer:
             for i, (a, o) in enumerate(zip(arrs, outs))
         ]
         sess.group_all_reduce(ws)
-        inv = np.float64(1.0) / n
-        return [
-            (o * o.dtype.type(inv)) if np.issubdtype(o.dtype, np.floating)
-            else o // n
-            for o in outs
-        ]
+        return [self._mean(o, n) for o in outs]
+
+    @staticmethod
+    def _mean(o: np.ndarray, n: int) -> np.ndarray:
+        """sum/n preserving dtype. NOTE the check must be issubdtype(...,
+        integer), not floating: ml_dtypes bfloat16 has numpy kind 'V', so
+        a floating-check would send bf16 down the integer floor-division
+        branch and zero out sub-1.0 gradient sums."""
+        if np.issubdtype(o.dtype, np.integer):
+            return o // n
+        if o.dtype.itemsize < 4:
+            # bf16/f16/f8: divide at f32 precision, round once at the end
+            return (o.astype(np.float32) / np.float32(n)).astype(o.dtype)
+        return o / o.dtype.type(n)
 
 
 def cross_slice_mean(tree, reducer: CrossSliceReducer):

@@ -230,6 +230,11 @@ class Watcher:
         # concurrent with everything else anyway, and the just-spawned
         # standbys may not have opened their FIFOs yet)
         if self.standby_pool is not None and self._initial_done:
+            # refill DEFERRED in every branch (success, dead slot, empty
+            # pool): a replacement standby's imports would compete with
+            # the joiner for CPU during the rebuild barrier — and a branch
+            # without a refill would drain the pool permanently
+            self._refill_at = time.monotonic() + self.REFILL_DELAY
             slot = self.standby_pool.take()
             if slot is not None:
                 if slot.activate(p.env, p.argv, p.name, p.rank):
@@ -237,10 +242,6 @@ class Watcher:
                           file=sys.stderr)
                     with self._state_lock:
                         self.current[w] = slot.proc
-                    # refill DEFERRED: a replacement standby's imports
-                    # would compete with the joiner for CPU during the
-                    # rebuild barrier — the critical path of the resize
-                    self._refill_at = time.monotonic() + self.REFILL_DELAY
                     return
                 # unreachable fifo: the standby is dead or wedged — never
                 # reusable, don't leak it

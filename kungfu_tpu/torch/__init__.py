@@ -1,0 +1,200 @@
+"""PyTorch frontend: distributed data parallelism over the host plane.
+
+Capability parity: srcs/python/kungfu/torch/__init__.py +
+srcs/cpp/src/torch/module_cpu.cpp — the reference serves TensorFlow AND
+PyTorch from one runtime. Here the same host collective engine (graph-walk
+allreduce over the kfrun cluster) backs torch tensors: gradients cross the
+numpy bridge zero-copy (torch CPU tensors share memory with numpy views).
+
+JAX remains the TPU compute path; this frontend covers the reference's
+second-framework contract for CPU torch and torch/XLA hosts:
+
+    from kungfu_tpu import torch as kf_torch
+    kf_torch.broadcast_parameters(model)
+    opt = kf_torch.SynchronousSGDOptimizer(torch.optim.SGD(model.parameters(), lr=0.1))
+    ...
+    loss.backward(); opt.step()
+"""
+
+from __future__ import annotations
+
+from typing import Iterable, List, Optional
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.base.ops import ReduceOp
+from kungfu_tpu.base.serialize import pack_leaves, unpack_leaves
+from kungfu_tpu.base.workspace import Workspace
+
+
+def _params_of(module_or_params) -> List:
+    if hasattr(module_or_params, "parameters"):
+        return list(module_or_params.parameters())
+    return list(module_or_params)
+
+
+def _flat_view(t) -> np.ndarray:
+    """Flat numpy view of a tensor: zero-copy for contiguous CPU tensors
+    (.cpu() is a no-op there); a host copy for XLA/CUDA tensors, whose
+    callers write the result back explicitly."""
+    return t.detach().cpu().contiguous().view(-1).numpy()
+
+
+_sync_round = [0]
+
+
+def sync_gradients(module_or_params, name: str = "torch-grad") -> None:
+    """Average .grad across the cluster in-place (parity:
+    _synchronize_grads, kungfu/torch/optimizers.py). One windowed group
+    allreduce over the host plane; no-op for a cluster of one. Wire names
+    carry a per-process round counter: a peer that finishes round k and
+    immediately starts k+1 must not have its sends consumed by a slower
+    peer still waiting on round k."""
+    size = api.cluster_size()
+    if size <= 1:
+        return
+    params = [p for p in _params_of(module_or_params) if p.grad is not None]
+    if not params:
+        return
+    rnd = _sync_round[0]
+    _sync_round[0] += 1
+    views = [_flat_view(p.grad) for p in params]
+    sess = api.get_default_peer().current_session()
+    ws = [
+        Workspace(send=v, recv=v, op=ReduceOp.SUM,
+                  name=f"kungfu::torch:{name}:{rnd}:{i}")
+        for i, v in enumerate(views)
+    ]
+    sess.group_all_reduce(ws)
+    inv = 1.0 / size
+    for p, v in zip(params, views):
+        v *= v.dtype.type(inv)
+        # v aliases p.grad's storage for CPU tensors; if torch had to
+        # copy (non-CPU / non-contiguous), write the result back
+        if p.grad.device.type != "cpu" or not p.grad.is_contiguous():
+            import torch
+
+            p.grad.copy_(torch.from_numpy(v).view_as(p.grad))
+
+
+def broadcast_parameters(module_or_params, root: int = 0,
+                         name: str = "torch-init") -> None:
+    """Replace every param with root's values (parity:
+    broadcast_parameters, kungfu/torch/__init__.py)."""
+    import torch
+
+    if api.cluster_size() <= 1:
+        return
+    params = _params_of(module_or_params)
+    sess = api.get_default_peer().current_session()
+    blob = pack_leaves([_flat_view(p) for p in params])
+    out = sess.broadcast_bytes(blob, f"kungfu::torch:{name}", root=root)
+    if sess.rank == root:
+        return
+    leaves = unpack_leaves(out, len(params))
+    with torch.no_grad():
+        for p, l in zip(params, leaves):
+            p.copy_(torch.from_numpy(np.ascontiguousarray(l)).view_as(p))
+
+
+def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, name: str = "torch-ar"):
+    """AllReduce a single tensor, returning a new tensor (parity:
+    all_reduce_fn)."""
+    import torch
+
+    arr = _flat_view(tensor).copy()
+    out = api.all_reduce_array(arr, op=op, name=name)
+    return torch.from_numpy(out).view_as(tensor).to(tensor.dtype)
+
+
+class SynchronousSGDOptimizer:
+    """S-SGD wrapper over any torch optimizer (parity:
+    SynchronousSGDOptimizer, kungfu/torch/optimizers.py): averages
+    gradients across the cluster, then applies the base step."""
+
+    def __init__(self, base, name: str = "ssgd"):
+        self.base = base
+        self.name = name
+        self._step = 0
+
+    def step(self, closure=None):
+        params = [
+            p for group in self.base.param_groups for p in group["params"]
+        ]
+        sync_gradients(params, name=f"{self.name}:{self._step}")
+        self._step += 1
+        return self.base.step(closure)
+
+    def __getattr__(self, item):
+        return getattr(self.base, item)
+
+
+class PairAveragingOptimizer:
+    """AD-PSGD for torch (parity: PairAveragingOptimizer): apply the local
+    step, then average parameters 0.5/0.5 with a random peer's published
+    model via the versioned p2p store."""
+
+    def __init__(self, base, name: str = "torch-pair", rng=None):
+        import random
+
+        self.base = base
+        self.blob = f"pair-avg-torch:{name}"
+        self.rng = rng or random.Random(api.current_rank() * 6007 + 13)
+        self._version = 0
+        self._published = False
+
+    def _params(self) -> List:
+        return [p for g in self.base.param_groups for p in g["params"]]
+
+    def _publish(self) -> None:
+        p2p = api.get_default_peer().p2p
+        blob = pack_leaves([_flat_view(p) for p in self._params()])
+        p2p.save_version(self._version, self.blob, blob)
+        self._version += 1
+
+    def _random_peer(self) -> Optional[int]:
+        size = api.cluster_size()
+        if size <= 1:
+            return None
+        r = self.rng.randrange(size - 1)
+        me = api.current_rank()
+        return r + 1 if r >= me else r
+
+    def step(self, closure=None):
+        import torch
+
+        if not self._published:
+            # first step: publish + fence so every peer has a model to serve
+            self._publish()
+            api.run_barrier()
+            self._published = True
+        out = self.base.step(closure)
+        target = self._random_peer()
+        if target is not None:
+            sess = api.get_default_peer().current_session()
+            p2p = api.get_default_peer().p2p
+            try:
+                data = p2p.request(
+                    sess.peers[target], self.blob, timeout=30, version="latest"
+                )
+            except (ConnectionError, TimeoutError, OSError):
+                data = None
+            params = self._params()
+            if data is not None:
+                try:
+                    leaves = unpack_leaves(bytes(data), len(params))
+                except (ValueError, KeyError):
+                    leaves = None
+                if leaves is not None:
+                    with torch.no_grad():
+                        for p, l in zip(params, leaves):
+                            other = torch.from_numpy(
+                                np.ascontiguousarray(l)
+                            ).view_as(p)
+                            p.mul_(0.5).add_(other, alpha=0.5)
+        self._publish()
+        return out
+
+    def __getattr__(self, item):
+        return getattr(self.base, item)

@@ -41,20 +41,27 @@ class _Box:
     this key's waiters (one shared condition would thundering-herd every
     in-flight chunk walk on every message)."""
 
-    __slots__ = ("cond", "msgs", "sinks", "waiters")
+    __slots__ = ("cond", "msgs", "sinks", "waiters", "dead")
 
     def __init__(self):
         self.cond = threading.Condition()
         self.msgs: deque = deque()
         self.sinks: deque = deque()
         self.waiters = 0
+        self.dead = False
 
     def idle(self) -> bool:
         return not self.msgs and not self.sinks and self.waiters == 0
 
 
 class _Rendezvous:
-    """Blocking mailboxes per (src, name), with optional registered sinks."""
+    """Blocking mailboxes per (src, name), with optional registered sinks.
+
+    GC protocol: a box leaves the dict only after being marked ``dead``
+    under its own condition (``_gc_locked``), and every writer/waiter
+    re-fetches the box when it observes ``dead`` — otherwise a put() that
+    grabbed a box reference just before GC would append to an orphan no
+    future get() can see, stranding a collective until its timeout."""
 
     def __init__(self):
         self._lock = threading.Lock()  # guards the box dict only
@@ -67,37 +74,47 @@ class _Rendezvous:
                 b = self._boxes[key] = _Box()
             return b
 
-    def _gc(self, key, box: _Box) -> None:
-        # names are version/chunk-tagged: drop drained mailboxes so long
-        # elastic runs don't accumulate dead keys
-        with self._lock:
-            if box.idle() and self._boxes.get(key) is box:
-                del self._boxes[key]
+    def _gc_locked(self, key, box: _Box) -> None:
+        """Drop a drained mailbox (long elastic runs must not accumulate
+        dead version/chunk-tagged keys). box.cond MUST be held; the dict
+        lock nests inside it (nothing acquires box.cond while holding the
+        dict lock, so the order cannot invert)."""
+        if box.idle() and not box.dead:
+            with self._lock:
+                if self._boxes.get(key) is box:
+                    box.dead = True
+                    del self._boxes[key]
 
     def put(self, src: PeerID, msg: Message) -> None:
         key = (src, msg.name)
-        box = self._box(key)
-        with box.cond:
-            box.msgs.append(msg)
-            # notify_all: waiters include get() consumers AND get_into()
-            # sink-parkers whose predicates differ; per-key wakeups are 1-2
-            # threads, so this is cheap
-            box.cond.notify_all()
+        while True:
+            box = self._box(key)
+            with box.cond:
+                if box.dead:
+                    continue  # lost the race with _gc_locked: re-fetch
+                box.msgs.append(msg)
+                # notify_all: waiters include get() consumers AND
+                # get_into() sink-parkers whose predicates differ; per-key
+                # wakeups are 1-2 threads, so this is cheap
+                box.cond.notify_all()
+                return
 
     def get(self, src: PeerID, name: str, timeout: Optional[float] = None) -> Message:
         key = (src, name)
-        box = self._box(key)
-        with box.cond:
-            box.waiters += 1
-            try:
-                ok = box.cond.wait_for(lambda: len(box.msgs) > 0, timeout)
-                if not ok:
-                    raise TimeoutError(f"recv timeout: {name} from {src}")
-                return box.msgs.popleft()
-            finally:
-                box.waiters -= 1
-                if box.idle():
-                    self._gc(key, box)
+        while True:
+            box = self._box(key)
+            with box.cond:
+                if box.dead:
+                    continue
+                box.waiters += 1
+                try:
+                    ok = box.cond.wait_for(lambda: len(box.msgs) > 0, timeout)
+                    if not ok:
+                        raise TimeoutError(f"recv timeout: {name} from {src}")
+                    return box.msgs.popleft()
+                finally:
+                    box.waiters -= 1
+                    self._gc_locked(key, box)
 
     # -- zero-copy receive ------------------------------------------------
 
@@ -110,6 +127,7 @@ class _Rendezvous:
         if box is None:
             return None
         with box.cond:
+            # a dead box has no sinks by construction; the loop is empty
             for s in box.sinks:
                 if s.state == _Sink.WAITING and s.view.nbytes == nbytes:
                     s.state = _Sink.TAKEN
@@ -118,14 +136,18 @@ class _Rendezvous:
 
     def finish_sink(self, src: PeerID, name: str, sink: _Sink, flags: Flags, ok: bool) -> None:
         key = (src, name)
-        box = self._box(key)
-        with box.cond:
-            sink.flags = flags
-            sink.state = _Sink.DONE if ok else _Sink.FAILED
-            box.cond.notify_all()
-        # pathological path: the receiver gave up mid-fill and its box was
-        # GC'd; don't let the re-created box linger
-        self._gc(key, box)
+        while True:
+            box = self._box(key)
+            with box.cond:
+                if box.dead:
+                    continue
+                sink.flags = flags
+                sink.state = _Sink.DONE if ok else _Sink.FAILED
+                box.cond.notify_all()
+                # pathological path: the receiver gave up mid-fill and its
+                # box was GC'd; don't let a re-created box linger
+                self._gc_locked(key, box)
+                return
 
     def get_into(
         self, src: PeerID, name: str, view: memoryview, timeout: Optional[float]
@@ -137,44 +159,49 @@ class _Rendezvous:
         registration, or size mismatch). On timeout with the sink mid-fill
         (TAKEN), the buffer must NOT be reused — the caller leaks it."""
         key = (src, name)
-        box = self._box(key)
         sink = _Sink(view)
-        with box.cond:
-            box.waiters += 1
-            try:
-                if box.msgs:
+        while True:
+            box = self._box(key)
+            with box.cond:
+                if box.dead:
+                    continue  # lost the race with _gc_locked: re-fetch
+                box.waiters += 1
+                try:
+                    if box.msgs:
+                        return box.msgs.popleft(), False
+                    box.sinks.append(sink)
+
+                    def ready():
+                        return sink.state in (_Sink.DONE, _Sink.FAILED) or box.msgs
+
+                    ok = box.cond.wait_for(ready, timeout)
+                    if sink.state == _Sink.TAKEN:
+                        # transport thread is writing into view RIGHT NOW;
+                        # wait for it to finish rather than handing a live
+                        # buffer back
+                        box.cond.wait_for(
+                            lambda: sink.state in (_Sink.DONE, _Sink.FAILED), 30.0
+                        )
+                    if sink.state == _Sink.DONE:
+                        box.sinks.remove(sink)
+                        return None, True
+                    if sink.state == _Sink.FAILED:
+                        box.sinks.remove(sink)
+                        raise ConnectionError(
+                            f"recv failed mid-frame: {name} from {src}"
+                        )
+                    if sink.state == _Sink.TAKEN:
+                        box.sinks.remove(sink)
+                        raise TimeoutError(f"recv stuck mid-frame: {name} from {src}")
+                    # WAITING: nothing touched the buffer
+                    sink.state = _Sink.CANCELLED
+                    box.sinks.remove(sink)
+                    if not ok:
+                        raise TimeoutError(f"recv timeout: {name} from {src}")
                     return box.msgs.popleft(), False
-                box.sinks.append(sink)
-
-                def ready():
-                    return sink.state in (_Sink.DONE, _Sink.FAILED) or box.msgs
-
-                ok = box.cond.wait_for(ready, timeout)
-                if sink.state == _Sink.TAKEN:
-                    # transport thread is writing into view RIGHT NOW; wait
-                    # for it to finish rather than handing a live buffer back
-                    box.cond.wait_for(
-                        lambda: sink.state in (_Sink.DONE, _Sink.FAILED), 30.0
-                    )
-                if sink.state == _Sink.DONE:
-                    box.sinks.remove(sink)
-                    return None, True
-                if sink.state == _Sink.FAILED:
-                    box.sinks.remove(sink)
-                    raise ConnectionError(f"recv failed mid-frame: {name} from {src}")
-                if sink.state == _Sink.TAKEN:
-                    box.sinks.remove(sink)
-                    raise TimeoutError(f"recv stuck mid-frame: {name} from {src}")
-                # WAITING: nothing touched the buffer
-                sink.state = _Sink.CANCELLED
-                box.sinks.remove(sink)
-                if not ok:
-                    raise TimeoutError(f"recv timeout: {name} from {src}")
-                return box.msgs.popleft(), False
-            finally:
-                box.waiters -= 1
-                if box.idle():
-                    self._gc(key, box)
+                finally:
+                    box.waiters -= 1
+                    self._gc_locked(key, box)
 
 
 class CollectiveEndpoint:

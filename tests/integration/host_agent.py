@@ -44,6 +44,24 @@ def main() -> int:
 
     api.run_barrier()
 
+    # rooted broadcast/gather (arbitrary roots, parity: Gather/Broadcast)
+    for root in {0, size - 1}:
+        b = api.broadcast_array(
+            np.full(5, rank, np.float32), root=root, name=f"b{root}"
+        )
+        assert np.all(b == root), f"bcast root={root}: {b}"
+        g = api.gather_arrays(
+            np.array([rank, rank], np.int32), root=root, name=f"g{root}"
+        )
+        if rank == root:
+            assert g.shape == (size, 2) and all(
+                np.all(g[r] == r) for r in range(size)
+            ), g
+        else:
+            assert g is None
+
+    api.run_barrier()
+
     # p2p save/request ring
     api.save("blob", bytes([rank] * 8))
     api.run_barrier()

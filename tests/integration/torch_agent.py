@@ -1,0 +1,61 @@
+"""Agent for the torch-frontend e2e: broadcast + S-SGD + pair averaging
+over the host plane, np=2 CPU torch."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+import torch
+
+from kungfu_tpu import api
+from kungfu_tpu import torch as kf_torch
+
+torch.manual_seed(1234 + api.current_rank())  # intentionally different
+rank, size = api.current_rank(), api.cluster_size()
+
+model = torch.nn.Linear(4, 2, bias=True)
+kf_torch.broadcast_parameters(model)
+w0 = model.weight.detach().clone()
+
+# S-SGD: rank-dependent data, identical params afterwards
+opt = kf_torch.SynchronousSGDOptimizer(
+    torch.optim.SGD(model.parameters(), lr=0.5)
+)
+for step in range(3):
+    x = torch.full((2, 4), float(rank + 1 + step))
+    y = torch.zeros(2, 2)
+    opt.zero_grad()
+    loss = torch.nn.functional.mse_loss(model(x), y)
+    loss.backward()
+    opt.step()
+
+flat = np.concatenate(
+    [p.detach().numpy().ravel() for p in model.parameters()]
+)
+digest = flat.tobytes().hex()
+print(f"TORCH rank={rank} ssgd={digest}", flush=True)
+
+# manual check on rank 0's side: grads were averaged, not local
+g = api.all_reduce_array(flat, name="check")  # sums identical vectors
+assert np.allclose(g, flat * size), "params diverged across ranks"
+
+# pair averaging: start from rank-dependent params, a few steps shrink
+# the spread
+model2 = torch.nn.Linear(3, 1, bias=False)
+with torch.no_grad():
+    model2.weight.fill_(float(rank * 8))
+popt = kf_torch.PairAveragingOptimizer(
+    torch.optim.SGD(model2.parameters(), lr=0.0)
+)
+for step in range(6):
+    popt.zero_grad()
+    out = model2(torch.ones(1, 3)).sum()
+    out.backward()
+    popt.step()
+    api.run_barrier()  # lockstep so both sides keep publishing fresh models
+spread = float(model2.weight.detach().abs().mean())
+print(f"TORCH rank={rank} pair_mean={spread:.3f}", flush=True)
+assert 0.5 < spread < 7.5, f"no contraction: {spread}"
+print(f"TORCH rank={rank} OK", flush=True)

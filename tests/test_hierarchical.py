@@ -102,3 +102,20 @@ def test_hier_two_worlds_bit_identical_to_single_world():
         f"hierarchical params diverge from single-world reference by "
         f"{ulp.max()} ULP\nhier: {results[0][:64]}...\nref:  {ref_hex[:64]}..."
     )
+
+
+def test_cross_slice_mean_dtypes():
+    """bf16 must NOT floor-divide (ml_dtypes kind 'V' is not
+    np.floating); ints floor; f32/f64 divide natively."""
+    import jax.numpy as jnp
+
+    from kungfu_tpu.ops.hierarchical import CrossSliceReducer
+
+    bf16 = np.asarray(jnp.zeros(0, jnp.bfloat16)).dtype
+    m = CrossSliceReducer._mean
+    out = m(np.array([1.0, 3.0], bf16), 2)
+    assert out.dtype == bf16
+    np.testing.assert_array_equal(out.astype(np.float32), [0.5, 1.5])
+    np.testing.assert_array_equal(m(np.array([5, 7], np.int32), 2), [2, 3])
+    np.testing.assert_allclose(m(np.array([1.0, 3.0], np.float64), 2), [0.5, 1.5])
+    assert m(np.array([1.0], np.float32), 4).dtype == np.float32

@@ -1,0 +1,66 @@
+"""PyTorch frontend over the host plane (parity: kungfu/torch/__init__.py
++ module_cpu.cpp — the reference's second-framework contract)."""
+
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+torch = pytest.importorskip("torch")
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AGENT = os.path.join(REPO, "tests", "integration", "torch_agent.py")
+
+
+def test_single_process_noops():
+    """Cluster of one: sync/broadcast are no-ops, wrapper still steps."""
+    from kungfu_tpu import torch as kf_torch
+
+    model = torch.nn.Linear(2, 1, bias=False)
+    with torch.no_grad():
+        model.weight.fill_(1.0)
+    kf_torch.broadcast_parameters(model)
+    opt = kf_torch.SynchronousSGDOptimizer(
+        torch.optim.SGD(model.parameters(), lr=0.5)
+    )
+    opt.zero_grad()
+    loss = model(torch.ones(1, 2)).sum()
+    loss.backward()
+    opt.step()
+    np.testing.assert_allclose(
+        model.weight.detach().numpy(), [[0.5, 0.5]], rtol=1e-6
+    )
+
+
+def test_all_reduce_tensor_single():
+    from kungfu_tpu import torch as kf_torch
+
+    t = torch.arange(6, dtype=torch.float32).view(2, 3)
+    out = kf_torch.all_reduce(t)
+    assert torch.equal(out, t)
+
+
+def test_torch_e2e_two_workers():
+    """kfrun np=2: broadcast equalizes params, S-SGD keeps them
+    bit-identical across ranks with rank-dependent data, PairAveraging
+    contracts divergent models."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2", "-H", "127.0.0.1:2",
+            sys.executable, AGENT,
+        ],
+        env=env, capture_output=True, text=True, timeout=300, cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    oks = [l for l in r.stdout.splitlines() if "OK" in l]
+    assert len(oks) == 2, r.stdout
+    digests = {
+        l.split("ssgd=")[1].strip()
+        for l in r.stdout.splitlines() if "ssgd=" in l
+    }
+    assert len(digests) == 1, "S-SGD params differ across ranks"
