@@ -1,0 +1,71 @@
+"""Multi-slice data parallelism: ICI psum within each slice, host-plane
+allreduce across slices — one jitted step per world.
+
+Each kfrun worker owns one jax world (one TPU slice / ICI domain); the
+cross-slice gradient average rides the DCN host plane from INSIDE the
+compiled step (parity: the reference's hierarchical NCCL+CPU allreduce,
+gpu/collective.cpp:108-162). Run it:
+
+  kfrun -np 2 -H 127.0.0.1:2 python3 examples/multislice_train.py
+
+On real hardware each worker would see its own slice's chips; here each
+worker self-provisions a 4-device virtual CPU world so the full dp-within
+x dp-across composition runs anywhere.
+"""
+
+import argparse
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=10)
+    p.add_argument("--devices", type=int, default=4,
+                   help="virtual devices per worker (0 = real backend)")
+    args = p.parse_args()
+
+    import jax
+
+    if args.devices:
+        jax.config.update("jax_platforms", "cpu")
+        jax.config.update("jax_num_cpu_devices", args.devices)
+
+    import jax.numpy as jnp
+    import numpy as np
+    import optax
+
+    from kungfu_tpu import api
+    from kungfu_tpu.models.mlp import init_mlp, mlp_loss
+    from kungfu_tpu.ops.hierarchical import make_hier_train_step
+    from kungfu_tpu.parallel import make_mesh
+
+    rank, size = api.current_rank(), api.cluster_size()
+    mesh = make_mesh()  # all this world's devices on "dp"
+    ndev = mesh.devices.size
+
+    params = init_mlp(jax.random.PRNGKey(42))  # same seed in every world
+    opt = optax.sgd(0.1)
+    step = make_hier_train_step(mlp_loss, opt, mesh)
+    opt_state = opt.init(params)
+
+    # each world takes a disjoint shard of the global batch
+    per_world = 64 * ndev
+    key = jax.random.PRNGKey(1000 + rank)
+    for i in range(args.steps):
+        key, k1, k2 = jax.random.split(key, 3)
+        x = jax.random.normal(k1, (per_world, 784))
+        y = jax.random.randint(k2, (per_world,), 0, 10)
+        params, opt_state, loss = step(params, opt_state, (x, y))
+        if rank == 0:
+            print(f"step {i}: loss {float(loss):.4f} "
+                  f"({size} worlds x {ndev} devices)", flush=True)
+
+    # worlds must agree bitwise: the cross-slice sync keeps them lockstep
+    flat = np.concatenate([np.ravel(l) for l in jax.tree.leaves(
+        jax.device_get(params))])
+    digest = api.all_reduce_array(flat, name="check")
+    assert np.allclose(digest, flat * size), "worlds diverged"
+    print(f"rank {rank}: worlds in sync after {args.steps} steps", flush=True)
+
+
+if __name__ == "__main__":
+    main()

@@ -96,9 +96,9 @@ def broadcast_array(x: np.ndarray, root: int = 0, name: str = "user") -> np.ndar
     """Host-plane broadcast from `root` (arbitrary roots, parity: the
     reference's Broadcast op)."""
     flat = np.ascontiguousarray(x).reshape(-1)
+    # no root-side copy needed: the bcast root has no prevs, so the graph
+    # walk's forward() performs the send->recv copy itself
     out = np.empty_like(flat)
-    if current_rank() == root:
-        np.copyto(out, flat)
     w = Workspace(send=flat, recv=out, op=ReduceOp.SUM,
                   name=f"kungfu::user::bcast:{name}")
     get_default_peer().current_session().broadcast(w, root=root)

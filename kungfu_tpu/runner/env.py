@@ -90,7 +90,7 @@ def worker_env(
     self_id: PeerID,
     peers: PeerList,
     runners: PeerList,
-    parent: PeerID,
+    parent: Optional[PeerID],
     cluster_version: int = 0,
     strategy: Strategy = DEFAULT_STRATEGY,
     config_server: str = "",
@@ -103,7 +103,7 @@ def worker_env(
         SELF_SPEC: str(self_id),
         INIT_PEERS: ",".join(str(p) for p in peers),
         INIT_RUNNERS: ",".join(str(r) for r in runners),
-        PARENT_ID: str(parent),
+        PARENT_ID: str(parent) if parent is not None else "",
         INIT_CLUSTER_VERSION: str(cluster_version),
         ALLREDUCE_STRATEGY: strategy.name,
         INIT_PROGRESS: str(init_progress),

@@ -37,8 +37,28 @@ def _params_of(module_or_params) -> List:
 def _flat_view(t) -> np.ndarray:
     """Flat numpy view of a tensor: zero-copy for contiguous CPU tensors
     (.cpu() is a no-op there); a host copy for XLA/CUDA tensors, whose
-    callers write the result back explicitly."""
-    return t.detach().cpu().contiguous().view(-1).numpy()
+    callers write the result back explicitly. bfloat16 crosses the bridge
+    by bit-reinterpretation (torch refuses .numpy() on bf16) and comes out
+    as an ml_dtypes.bfloat16 array, which the host engine reduces
+    natively."""
+    import torch
+
+    t = t.detach().cpu().contiguous().view(-1)
+    if t.dtype == torch.bfloat16:
+        import ml_dtypes
+
+        return t.view(torch.int16).numpy().view(ml_dtypes.bfloat16)
+    return t.numpy()
+
+
+def _to_torch(arr: np.ndarray):
+    """numpy -> torch, inverting _flat_view's bf16 reinterpretation."""
+    import torch
+
+    arr = np.ascontiguousarray(arr)
+    if arr.dtype.kind == "V" and arr.dtype.itemsize == 2:  # ml_dtypes bf16
+        return torch.from_numpy(arr.view(np.int16)).view(torch.bfloat16)
+    return torch.from_numpy(arr)
 
 
 _sync_round = [0]
@@ -73,9 +93,7 @@ def sync_gradients(module_or_params, name: str = "torch-grad") -> None:
         # v aliases p.grad's storage for CPU tensors; if torch had to
         # copy (non-CPU / non-contiguous), write the result back
         if p.grad.device.type != "cpu" or not p.grad.is_contiguous():
-            import torch
-
-            p.grad.copy_(torch.from_numpy(v).view_as(p.grad))
+            p.grad.copy_(_to_torch(v).view_as(p.grad))
 
 
 def broadcast_parameters(module_or_params, root: int = 0,
@@ -95,17 +113,15 @@ def broadcast_parameters(module_or_params, root: int = 0,
     leaves = unpack_leaves(out, len(params))
     with torch.no_grad():
         for p, l in zip(params, leaves):
-            p.copy_(torch.from_numpy(np.ascontiguousarray(l)).view_as(p))
+            p.copy_(_to_torch(l).view_as(p))
 
 
 def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, name: str = "torch-ar"):
-    """AllReduce a single tensor, returning a new tensor (parity:
-    all_reduce_fn)."""
-    import torch
-
-    arr = _flat_view(tensor).copy()
-    out = api.all_reduce_array(arr, op=op, name=name)
-    return torch.from_numpy(out).view_as(tensor).to(tensor.dtype)
+    """AllReduce a single tensor, returning a new tensor on the input's
+    device (parity: all_reduce_fn). all_reduce_array never mutates its
+    input and returns a fresh buffer, so no defensive copy is needed."""
+    out = api.all_reduce_array(_flat_view(tensor), op=op, name=name)
+    return _to_torch(out).view_as(tensor).to(tensor.device)
 
 
 class SynchronousSGDOptimizer:
@@ -189,10 +205,7 @@ class PairAveragingOptimizer:
                 if leaves is not None:
                     with torch.no_grad():
                         for p, l in zip(params, leaves):
-                            other = torch.from_numpy(
-                                np.ascontiguousarray(l)
-                            ).view_as(p)
-                            p.mul_(0.5).add_(other, alpha=0.5)
+                            p.mul_(0.5).add_(_to_torch(l).view_as(p), alpha=0.5)
         self._publish()
         return out
 

@@ -64,3 +64,33 @@ def test_torch_e2e_two_workers():
         for l in r.stdout.splitlines() if "ssgd=" in l
     }
     assert len(digests) == 1, "S-SGD params differ across ranks"
+
+
+def test_bf16_numpy_bridge_roundtrip():
+    """torch bf16 crosses the numpy bridge by bit-reinterpretation (torch
+    refuses .numpy() on bf16); _to_torch inverts it exactly."""
+    from kungfu_tpu.torch import _flat_view, _to_torch
+
+    t = torch.tensor([0.5, -1.25, 3.0, 65280.0], dtype=torch.bfloat16)
+    v = _flat_view(t)
+    assert v.dtype.itemsize == 2 and str(v.dtype) == "bfloat16"
+    back = _to_torch(v)
+    assert back.dtype == torch.bfloat16
+    assert torch.equal(back, t)
+
+
+def test_bf16_sync_and_allreduce_single():
+    """bf16 params/grads work through sync_gradients and all_reduce
+    (cluster of one: identity, but the whole bridge executes)."""
+    from kungfu_tpu import torch as kf_torch
+
+    model = torch.nn.Linear(3, 1, bias=False).to(torch.bfloat16)
+    kf_torch.broadcast_parameters(model)
+    loss = model(torch.ones(1, 3, dtype=torch.bfloat16)).sum()
+    loss.backward()
+    g0 = model.weight.grad.detach().clone()
+    kf_torch.sync_gradients(model)
+    assert torch.equal(model.weight.grad, g0)
+    out = kf_torch.all_reduce(model.weight.detach())
+    assert out.dtype == torch.bfloat16
+    assert torch.equal(out, model.weight.detach())
