@@ -59,11 +59,16 @@ def main():
             print(f"step {i}: loss {float(loss):.4f} "
                   f"({size} worlds x {ndev} devices)", flush=True)
 
-    # worlds must agree bitwise: the cross-slice sync keeps them lockstep
+    # worlds must agree bitwise: the cross-slice sync keeps them lockstep.
+    # MIN and MAX allreduce both equal to the local value is an exact
+    # cross-world equality check (a summed allclose could hide drift)
+    from kungfu_tpu.base.ops import ReduceOp
+
     flat = np.concatenate([np.ravel(l) for l in jax.tree.leaves(
         jax.device_get(params))])
-    digest = api.all_reduce_array(flat, name="check")
-    assert np.allclose(digest, flat * size), "worlds diverged"
+    lo = api.all_reduce_array(flat, ReduceOp.MIN, name="check-min")
+    hi = api.all_reduce_array(flat, ReduceOp.MAX, name="check-max")
+    assert np.array_equal(lo, flat) and np.array_equal(hi, flat), "worlds diverged"
     print(f"rank {rank}: worlds in sync after {args.steps} steps", flush=True)
 
 

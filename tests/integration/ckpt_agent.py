@@ -1,0 +1,52 @@
+"""Agent for the checkpoint-resume e2e: trains under kfrun -auto-recover,
+checkpoints each epoch, crashes once, and must resume from the saved
+state rather than step 0."""
+
+import os
+import sys
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import jax
+
+# orbax initializes a jax backend; multiple workers cannot share the one
+# real chip, so this host-plane agent pins CPU
+jax.config.update("jax_platforms", "cpu")
+
+import jax.numpy as jnp
+import numpy as np
+
+from kungfu_tpu import api, cmd
+from kungfu_tpu.elastic.checkpoint import Checkpointer
+
+CKDIR = sys.argv[1]
+EPOCHS = 5
+
+rank = api.current_rank()
+restart = "--restart" in sys.argv
+
+ckpt = Checkpointer(CKDIR, save_rank=0)
+state, start = ckpt.restore_or({"acc": jnp.zeros(3)})
+print(f"agent rank={rank} restart={restart} start={start}", flush=True)
+if restart:
+    assert start >= 2, f"resume lost the checkpoint: start={start}"
+
+for epoch in range(start, EPOCHS):
+    cmd.monitor_batch_begin(rank)
+    # "training": every epoch adds the epoch index, allreduced
+    delta = api.all_reduce_array(
+        np.full(3, float(epoch)), name=f"e{epoch}"
+    ) / api.cluster_size()
+    state = {"acc": state["acc"] + jnp.asarray(delta)}
+    cmd.monitor_batch_end(rank)
+    ckpt.save(epoch + 1, state)
+    cmd.monitor_epoch_end(rank)
+    if epoch == 2 and not restart and rank == 0:
+        print("agent: crash after epoch 3 checkpoint", flush=True)
+        os._exit(5)
+
+expect = sum(range(EPOCHS))
+got = float(state["acc"][0])
+assert got == expect, (got, expect)
+cmd.monitor_train_end(rank)
+print(f"agent done rank={rank} acc={got}", flush=True)

@@ -189,3 +189,79 @@ def test_bind_fails_fast_on_non_transient_error(monkeypatch):
         srv.start(bind_timeout=15.0)
     assert ei.value.errno == errno.EACCES
     assert time.monotonic() - t0 < 2.0  # no retry loop
+
+
+class TestRendezvousGCStress:
+    """Hammer the per-key mailbox GC race (VERDICT r4 review): reused wire
+    names with immediate re-put after drain must never strand a message in
+    an orphaned box."""
+
+    def test_put_get_reuse_race(self):
+        import threading
+
+        from kungfu_tpu.plan.peer import PeerID
+        from kungfu_tpu.transport.handlers import _Rendezvous
+        from kungfu_tpu.transport.message import Message
+
+        rdv = _Rendezvous()
+        src = PeerID("127.0.0.1", 1)
+        N = 2000
+        errs = []
+
+        def producer():
+            for i in range(N):
+                rdv.put(src, Message(name="hot", data=b"%d" % i))
+
+        def consumer():
+            try:
+                for i in range(N):
+                    msg = rdv.get(src, "hot", timeout=20)
+                    assert msg.data == b"%d" % i
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=producer),
+                   threading.Thread(target=consumer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs, errs
+        assert not rdv._boxes, "drained boxes must be GC'd"
+
+    def test_sink_vs_put_race(self):
+        import threading
+
+        import numpy as np
+
+        from kungfu_tpu.plan.peer import PeerID
+        from kungfu_tpu.transport.handlers import _Rendezvous
+        from kungfu_tpu.transport.message import Message
+
+        rdv = _Rendezvous()
+        src = PeerID("127.0.0.1", 2)
+        N = 500
+        errs = []
+
+        def producer():
+            for i in range(N):
+                rdv.put(src, Message(name="s", data=bytes([i % 251] * 8)))
+
+        def consumer():
+            try:
+                for i in range(N):
+                    buf = bytearray(8)
+                    msg, filled = rdv.get_into(src, "s", memoryview(buf), 20)
+                    data = bytes(buf) if filled else bytes(msg.data)
+                    assert data == bytes([i % 251] * 8), (i, data)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        threads = [threading.Thread(target=producer),
+                   threading.Thread(target=consumer)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(60)
+        assert not errs, errs
+        assert not rdv._boxes
