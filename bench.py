@@ -94,7 +94,7 @@ def main() -> None:
     # best-of-windows: the minimum over several short windows rejects
     # interference from other tenants of the host (timeit-min methodology)
     best_dt = float("inf")
-    for _ in range(4):
+    for _ in range(8):
         iters = 8
         t0 = time.perf_counter()
         for _ in range(iters):

@@ -94,7 +94,10 @@ class ResNet(nn.Module):
     num_classes: int = 1000
     num_filters: int = 64
     dtype: Any = jnp.bfloat16
-    s2d_stem: bool = True
+    # space-to-depth stem: ~5% faster FORWARD on TPU (4x MXU occupancy on
+    # conv1) but measured flat on the full train step (XLA already folds
+    # stride-2 spatial dims into the conv), so inference configs opt in
+    s2d_stem: bool = False
 
     @nn.compact
     def __call__(self, x, train: bool = True):

@@ -109,7 +109,21 @@ def _rmsnorm(x, scale, eps=1e-6):
     return (x * jax.lax.rsqrt(var + eps)).astype(x.dtype) * scale.astype(x.dtype)
 
 
-def _attention(x, wqkv, wo, cfg: TransformerConfig):
+def _full_attention_core(q, k, v):
+    """(B, H, S, hd) q/k/v -> causal attention context, same shape."""
+    hd = q.shape[-1]
+    S = q.shape[2]
+    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd).astype(q.dtype)
+    mask = jnp.tril(jnp.ones((S, S), bool))
+    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(q.dtype)
+    return jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+
+
+def _attention(x, wqkv, wo, cfg: TransformerConfig, core=_full_attention_core):
+    """QKV projection + head reshape around a pluggable (q,k,v)->ctx core
+    (full attention by default, the ring core for sequence parallelism —
+    ONE copy of the projection plumbing for both paths)."""
     B, S, D = x.shape
     H, hd = cfg.n_heads, cfg.head_dim
     qkv = x @ wqkv  # (B, S, 3D)
@@ -117,11 +131,7 @@ def _attention(x, wqkv, wo, cfg: TransformerConfig):
     q = q.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     k = k.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
     v = v.reshape(B, S, H, hd).transpose(0, 2, 1, 3)
-    scores = jnp.einsum("bhqd,bhkd->bhqk", q, k) / np.sqrt(hd).astype(x.dtype)
-    mask = jnp.tril(jnp.ones((S, S), bool))
-    scores = jnp.where(mask, scores, jnp.finfo(scores.dtype).min)
-    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1).astype(x.dtype)
-    ctx = jnp.einsum("bhqk,bhkd->bhqd", probs, v)
+    ctx = core(q, k, v)
     ctx = ctx.transpose(0, 2, 1, 3).reshape(B, S, D)
     return ctx @ wo
 
@@ -160,3 +170,77 @@ def transformer_loss(params, batch, cfg: TransformerConfig):
     logp = jax.nn.log_softmax(logits)
     ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
     return -jnp.mean(ll)
+
+
+# ---------------------------------------------------------------------------
+# sequence-parallel (ring attention) path: the long-context mode. The whole
+# forward runs per sequence-SHARD inside a shard_map over (dp, sp) — token
+# embedding, norms and FFN are pointwise over positions, so only attention
+# needs cross-shard traffic, and that traffic is the K/V ring on ICI
+# (ops/ring_attention.py). Peak activation memory per chip scales with
+# S/sp instead of S.
+# ---------------------------------------------------------------------------
+
+
+def ring_transformer_apply_shard(params, tokens, cfg: TransformerConfig,
+                                 sp_axis: str, sp_size: int):
+    """Per-shard forward for shard_map: tokens (B, S_local) is this
+    device's sequence chunk; returns per-shard logits (B, S_local, V)."""
+    from kungfu_tpu.ops.ring_attention import ring_self_attention
+
+    B, Sl = tokens.shape
+    if sp_size * Sl > cfg.max_seq:
+        # loud, like the dense path: dynamic_slice would otherwise CLAMP
+        # the out-of-range start and silently duplicate positional rows
+        raise ValueError(
+            f"global sequence {sp_size * Sl} exceeds max_seq {cfg.max_seq}"
+        )
+    dt = cfg.dtype
+    idx = jax.lax.axis_index(sp_axis)
+    pos = jax.lax.dynamic_slice(
+        params["pos_embed"], (idx * Sl, 0), (Sl, cfg.d_model)
+    )
+    x = params["embed"].astype(dt)[tokens] + pos.astype(dt)
+
+    def ring_core(q, k, v):
+        return ring_self_attention(q, k, v, sp_axis, sp_size, causal=True)
+
+    def body(x, layer):
+        x = x + _attention(
+            _rmsnorm(x, layer["ln1_scale"]),
+            layer["wqkv"].astype(dt), layer["wo"].astype(dt),
+            cfg, core=ring_core,
+        )
+        h = _rmsnorm(x, layer["ln2_scale"])
+        h = jax.nn.gelu(h @ layer["w_in"].astype(dt))
+        return x + h @ layer["w_out"].astype(dt), None
+
+    x, _ = jax.lax.scan(body, x, params["layers"])
+    x = _rmsnorm(x, params["ln_f_scale"])
+    return x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+
+
+def make_ring_transformer_loss(cfg: TransformerConfig, mesh,
+                               sp_axis: str = "sp", dp_axis: str = "dp"):
+    """Sequence-parallel causal-LM loss: batch = (tokens, targets), both
+    (B, S) with B divisible by dp and S by sp. Returns loss_fn(params,
+    batch) -> replicated scalar, jit/grad-compatible (shard_map inside)."""
+    from jax import shard_map
+
+    sp_size = mesh.shape[sp_axis]
+
+    def shard_loss(params, batch):
+        tokens, targets = batch
+        logits = ring_transformer_apply_shard(params, tokens, cfg, sp_axis, sp_size)
+        logp = jax.nn.log_softmax(logits)
+        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+        loss = -jnp.mean(ll)
+        return jax.lax.pmean(jax.lax.pmean(loss, sp_axis), dp_axis)
+
+    return shard_map(
+        shard_loss,
+        mesh=mesh,
+        in_specs=(P(), (P(dp_axis, sp_axis), P(dp_axis, sp_axis))),
+        out_specs=P(),
+        check_vma=False,
+    )

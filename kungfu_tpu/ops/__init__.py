@@ -13,6 +13,7 @@ from kungfu_tpu.ops.hierarchical import (
     make_hier_train_step,
     synchronous_sgd_hierarchical,
 )
+from kungfu_tpu.ops.ring_attention import ring_self_attention
 
 __all__ = [
     "all_gather",
@@ -26,4 +27,5 @@ __all__ = [
     "cross_slice_mean",
     "make_hier_train_step",
     "synchronous_sgd_hierarchical",
+    "ring_self_attention",
 ]

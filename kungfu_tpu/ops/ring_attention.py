@@ -1,0 +1,83 @@
+"""Ring attention: exact causal self-attention over a sequence-sharded
+mesh axis.
+
+Long-context parity goal (SURVEY §7 / BASELINE north star): the reference
+has no sequence parallelism at all; TPU-native long-context training needs
+attention over sequences larger than one chip's memory. This is the ring
+algorithm (Liu et al., "Ring Attention with Blockwise Transformers"): each
+device holds one sequence block of Q/K/V; K/V blocks rotate around the
+ring via `ppermute` while each device accumulates its queries' attention
+over every block with an online (flash-style) softmax — peak memory is
+O(S_local^2) scores instead of O(S^2), and the ring rides the ICI
+bidirectionally.
+
+Runs INSIDE a `shard_map` over the sequence axis. Accumulation is f32
+regardless of input dtype.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e30  # finite: exp(NEG_INF - NEG_INF) must be well-defined
+
+
+def ring_self_attention(q, k, v, axis_name: str, axis_size: int,
+                        causal: bool = True):
+    """Exact attention for sequence-sharded q, k, v of shape
+    (B, H, S_local, head_dim); the global sequence is axis_size * S_local
+    with device i (by `lax.axis_index`) holding block i. Returns the
+    (B, H, S_local, head_dim) context in q's dtype."""
+    B, H, Sl, hd = q.shape
+    out_dtype = q.dtype
+    idx = lax.axis_index(axis_name)
+    qf = q.astype(jnp.float32)
+    scale = 1.0 / jnp.sqrt(jnp.float32(hd))
+    qpos = idx * Sl + jnp.arange(Sl)[:, None]  # (Sl, 1) global query pos
+
+    perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
+
+    def accumulate(k_blk, v_blk, blk, m, l, o):
+        kpos = blk * Sl + jnp.arange(Sl)[None, :]  # (1, Sl) global key pos
+        scores = (
+            jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+            * scale
+        )
+        if causal:
+            mask = kpos <= qpos  # (Sl, Sl)
+            scores = jnp.where(mask, scores, NEG_INF)
+            maskf = mask.astype(jnp.float32)
+        else:
+            maskf = jnp.ones((Sl, Sl), jnp.float32)
+        m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
+        # p is explicitly zeroed on masked entries: when a block is fully
+        # masked m_new stays NEG_INF and exp(scores - m_new) would be 1
+        p = jnp.exp(scores - m_new) * maskf
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        return m_new, l, o
+
+    def body(step, carry):
+        # rotate FIRST (permute-before-compute): steps 1..n-1 do exactly
+        # n-1 ring rotations total — a rotate-after-compute loop would do
+        # one extra ppermute whose result is discarded, and XLA does not
+        # DCE collectives inside a while-loop body
+        k_blk, v_blk, m, l, o = carry
+        k_blk = lax.ppermute(k_blk, axis_name, perm)
+        v_blk = lax.ppermute(v_blk, axis_name, perm)
+        # after `step` rotations we hold the block that STARTED at
+        # (idx - step); its global positions follow from that block id
+        blk = (idx - step) % axis_size
+        m, l, o = accumulate(k_blk, v_blk, blk, m, l, o)
+        return k_blk, v_blk, m, l, o
+
+    m0 = jnp.full((B, H, Sl, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, Sl, 1), jnp.float32)
+    o0 = jnp.zeros((B, H, Sl, hd), jnp.float32)
+    m, l, o = accumulate(k, v, idx, m0, l0, o0)  # step 0: own block
+    _, _, _, l, o = lax.fori_loop(1, axis_size, body, (k, v, m, l, o))
+    # causal attention always has >= 1 unmasked key (the diagonal), so l>0
+    return (o / l).astype(out_dtype)

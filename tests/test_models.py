@@ -91,3 +91,25 @@ def test_fake_models():
     assert total_size_bytes("slp-mnist") == (784 * 10 + 10) * 4
     # resnet50 full gradient set is ~25M params * 4B ≈ 100MB
     assert 20e6 < sum(FAKE_MODELS["resnet50-imagenet"]) < 40e6
+
+
+def test_s2d_stem_equivalent_to_conv_stem():
+    """SpaceToDepthStem is numerically exact vs the 7x7/s2 conv (same
+    stored parameter, reassociated taps)."""
+    import jax
+    import jax.numpy as jnp
+    import numpy as np
+
+    from kungfu_tpu.models.resnet import ResNet, init_resnet
+
+    kw = dict(stage_sizes=[1, 1], num_classes=10, num_filters=8,
+              dtype=jnp.float32)
+    plain = ResNet(s2d_stem=False, **kw)
+    s2d = ResNet(s2d_stem=True, **kw)
+    key = jax.random.PRNGKey(0)
+    params, stats = init_resnet(key, plain, image_size=32, batch=2)
+    x = jax.random.normal(jax.random.PRNGKey(1), (2, 32, 32, 3), jnp.float32)
+    out_a = plain.apply({"params": params, "batch_stats": stats}, x, train=False)
+    out_b = s2d.apply({"params": params, "batch_stats": stats}, x, train=False)
+    np.testing.assert_allclose(np.asarray(out_a), np.asarray(out_b),
+                               rtol=2e-5, atol=2e-5)
