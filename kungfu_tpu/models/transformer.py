@@ -136,10 +136,11 @@ def _attention(x, wqkv, wo, cfg: TransformerConfig, core=_full_attention_core):
     return ctx @ wo
 
 
-def _block(x, layer, cfg: TransformerConfig):
+def _block(x, layer, cfg: TransformerConfig, core=_full_attention_core):
     dt = cfg.dtype
     x = x + _attention(_rmsnorm(x, layer["ln1_scale"]),
-                       layer["wqkv"].astype(dt), layer["wo"].astype(dt), cfg)
+                       layer["wqkv"].astype(dt), layer["wo"].astype(dt), cfg,
+                       core=core)
     h = _rmsnorm(x, layer["ln2_scale"])
     h = jax.nn.gelu(h @ layer["w_in"].astype(dt))
     return x + h @ layer["w_out"].astype(dt)
@@ -206,14 +207,8 @@ def ring_transformer_apply_shard(params, tokens, cfg: TransformerConfig,
         return ring_self_attention(q, k, v, sp_axis, sp_size, causal=True)
 
     def body(x, layer):
-        x = x + _attention(
-            _rmsnorm(x, layer["ln1_scale"]),
-            layer["wqkv"].astype(dt), layer["wo"].astype(dt),
-            cfg, core=ring_core,
-        )
-        h = _rmsnorm(x, layer["ln2_scale"])
-        h = jax.nn.gelu(h @ layer["w_in"].astype(dt))
-        return x + h @ layer["w_out"].astype(dt), None
+        # the ONE block implementation, with the ring attention core
+        return _block(x, layer, cfg, core=ring_core), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
     x = _rmsnorm(x, params["ln_f_scale"])
