@@ -1,0 +1,3 @@
+"""Environment/diagnostics report (parity: python -m kungfu.info,
+srcs/python/kungfu/info/__main__.py — CUDA/NCCL/TF versions become
+TPU/JAX/cluster facts)."""

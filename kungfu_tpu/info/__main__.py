@@ -1,0 +1,70 @@
+"""Usage: python3 -m kungfu_tpu.info [--no-devices]
+
+Prints framework, backend and cluster-env diagnostics (parity:
+python -m kungfu.info; the CUDA/NCCL/TF report becomes JAX/TPU/KF_* —
+what an operator actually needs when a TPU-VM worker misbehaves)."""
+
+import os
+import sys
+
+
+def _show_versions() -> None:
+    import kungfu_tpu
+
+    print(f"kungfu_tpu: {getattr(kungfu_tpu, '__version__', 'dev')} "
+          f"({os.path.dirname(kungfu_tpu.__file__)})")
+    try:
+        import jax
+
+        print(f"JAX: {jax.__version__}")
+    except ImportError:
+        print("JAX is NOT installed")
+    for mod in ("flax", "optax", "orbax.checkpoint", "torch"):
+        try:
+            m = __import__(mod)
+            for part in mod.split(".")[1:]:
+                m = getattr(m, part)
+            print(f"{mod}: {getattr(m, '__version__', '?')}")
+        except ImportError:
+            print(f"{mod} is NOT installed")
+
+
+def _show_devices() -> None:
+    try:
+        import jax
+
+        devs = jax.devices()
+        kinds = {}
+        for d in devs:
+            kinds.setdefault((d.platform, d.device_kind), []).append(d.id)
+        for (platform, kind), ids in kinds.items():
+            print(f"devices: {len(ids)} x {kind} ({platform})")
+    except Exception as e:  # noqa: BLE001 - a broken backend is a finding
+        print(f"device init FAILED: {e}")
+
+
+def _show_cluster_env() -> None:
+    kf = {k: v for k, v in os.environ.items() if k.startswith("KF_")}
+    if not kf:
+        print("cluster env: none (not under kfrun)")
+        return
+    print("cluster env:")
+    for k in sorted(kf):
+        print(f"  {k}={kf[k]}")
+
+
+def main(argv) -> None:
+    _show_versions()
+    if "--no-devices" not in argv:
+        _show_devices()
+    _show_cluster_env()
+    allowed = (
+        len(os.sched_getaffinity(0))
+        if hasattr(os, "sched_getaffinity")  # Linux-only
+        else os.cpu_count()
+    )
+    print(f"cpus: {allowed} allowed / {os.cpu_count()} online")
+
+
+if __name__ == "__main__":
+    main(sys.argv[1:])

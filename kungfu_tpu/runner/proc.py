@@ -16,6 +16,25 @@ from typing import Dict, List, Optional
 _COLORS = [31, 32, 33, 34, 35, 36, 91, 92, 93, 94, 95, 96]
 
 
+def _die_with_parent() -> None:
+    """preexec_fn: SIGTERM this child when the runner dies
+    (PR_SET_PDEATHSIG). A hard-killed runner (SIGKILL, OOM) never reaches
+    its cleanup paths; without this, workers and warm standbys orphan —
+    and an idle orphan can even pin the TPU tunnel claim. Runs between
+    fork and exec, so there is no exec-to-prctl race. CDLL(None) resolves
+    prctl from the running process under any Linux libc (a hardcoded
+    libc.so.6 would silently no-op on musl)."""
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        PR_SET_PDEATHSIG = 1
+        libc.prctl(PR_SET_PDEATHSIG, _signal.SIGTERM, 0, 0, 0)
+    except Exception:  # noqa: BLE001 - non-Linux: best-effort only
+        pass
+
+
 def _color(i: int, s: str) -> str:
     if not sys.stdout.isatty():
         return s
@@ -53,6 +72,7 @@ class WorkerProc:
             stderr=subprocess.PIPE,
             text=True,
             bufsize=1,
+            preexec_fn=_die_with_parent if os.name == "posix" else None,
         )
         if self.cpus:
             from kungfu_tpu.runner.affinity import apply_affinity

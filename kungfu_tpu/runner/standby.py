@@ -178,6 +178,8 @@ class StandbyPool:
 
 
 def main() -> None:
+    # orphan protection (PR_SET_PDEATHSIG) is applied by WorkerProc's
+    # preexec_fn, uniformly for standbys and cold-spawned workers
     fifo = os.environ.get("KF_STANDBY_FIFO", "")
     if not fifo:
         print("kf-standby: KF_STANDBY_FIFO not set", file=sys.stderr)

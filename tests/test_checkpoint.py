@@ -94,7 +94,7 @@ def test_checkpoint_resume_under_auto_recover(tmp_path):
             "-auto-recover", "30s",
             sys.executable, agent, str(tmp_path / "ck"),
         ],
-        env=env, capture_output=True, text=True, timeout=300, cwd=repo,
+        env=env, capture_output=True, text=True, timeout=540, cwd=repo,
     )
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "crash after epoch 3 checkpoint" in r.stdout

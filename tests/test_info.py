@@ -1,0 +1,19 @@
+"""python -m kungfu_tpu.info (parity: python -m kungfu.info)."""
+
+import os
+import subprocess
+import sys
+
+
+def test_info_runs():
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env["KF_SELF_SPEC"] = "127.0.0.1:7"
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.info", "--no-devices"],
+        capture_output=True, text=True, timeout=120, env=env,
+    )
+    assert r.returncode == 0, r.stderr
+    assert "kungfu_tpu:" in r.stdout
+    assert "JAX:" in r.stdout
+    assert "KF_SELF_SPEC=127.0.0.1:7" in r.stdout

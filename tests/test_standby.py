@@ -4,6 +4,8 @@ import os
 import sys
 import time
 
+import pytest
+
 from kungfu_tpu.runner.standby import StandbyPool
 
 
@@ -105,3 +107,44 @@ def test_tracer_spans():
     s = trace.summary_ms("t.")
     assert s["t.a"] >= 10.0
     assert s["t.b"] == 500.0
+
+
+@pytest.mark.skipif(sys.platform != "linux", reason="PR_SET_PDEATHSIG is Linux-only")
+def test_standby_dies_with_its_runner(tmp_path):
+    """A hard-killed runner must not leave orphaned standbys
+    (PR_SET_PDEATHSIG): spawn a 'runner' that creates one standby and
+    idles; SIGKILL the runner; the standby must exit on its own."""
+    import signal
+    import subprocess
+
+    script = tmp_path / "runner.py"
+    script.write_text(
+        "import sys, time\n"
+        f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+        "from kungfu_tpu.runner.standby import StandbyPool\n"
+        "pool = StandbyPool(1, quiet=True)\n"
+        "pool.refill()\n"
+        "print(pool.slots[0].proc.proc.pid, flush=True)\n"
+        "time.sleep(600)\n"
+    )
+    runner = subprocess.Popen(
+        [sys.executable, str(script)], stdout=subprocess.PIPE, text=True
+    )
+    try:
+        standby_pid = int(runner.stdout.readline())
+        # the standby is alive while the runner lives
+        os.kill(standby_pid, 0)
+        runner.kill()  # SIGKILL: no cleanup runs in the runner
+        runner.wait(10)
+        deadline = time.time() + 30
+        while time.time() < deadline:
+            try:
+                os.kill(standby_pid, 0)
+            except ProcessLookupError:
+                return  # orphan reaped itself
+            time.sleep(0.2)
+        os.kill(standby_pid, signal.SIGKILL)
+        raise AssertionError("standby outlived its killed runner")
+    finally:
+        if runner.poll() is None:
+            runner.kill()
