@@ -1,0 +1,77 @@
+"""Expert parallelism: switch-routed MoE FFN with all_to_all dispatch.
+
+Beyond-reference capability (the reference is data-parallel only,
+SURVEY §2.4); on TPU the expert dimension is a mesh axis and token
+dispatch is `lax.all_to_all` over ICI — the canonical TPU MoE layout
+(one expert group per device, capacity-bounded buckets).
+
+Top-1 (switch) routing with capacity dropping: each shard routes its
+tokens, packs them into per-expert capacity buckets, exchanges buckets
+with every peer via all_to_all, applies its local expert, and sends the
+results back the way they came. Dropped tokens (over capacity) pass
+through on the residual path (combine weight 0), the standard switch
+behavior.
+
+Runs INSIDE a shard_map over the expert axis. Experts = axis size (one
+expert per device); generalizing to k experts/device stacks an extra
+leading dim on the expert weights.
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+
+def switch_moe(x, router_w, w_in, w_out, axis_name: str, axis_size: int,
+               capacity_factor: float = 1.25):
+    """x (T, D) tokens on this shard; router_w (D, E); w_in (D, F),
+    w_out (F, D) are THIS device's expert. E == axis_size. Returns
+    (out (T, D), aux_loss) — out is zero for dropped tokens (caller adds
+    the residual), aux_loss is the switch load-balancing loss."""
+    T, D = x.shape
+    E = axis_size
+    C = max(1, int(capacity_factor * T / E))  # per (src, expert) capacity
+
+    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
+    expert = jnp.argmax(probs, axis=-1)  # (T,)
+    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # (T,)
+
+    # position of each token within its expert's capacity bucket
+    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # (T, E)
+    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per token
+    slot = jnp.sum(pos, axis=-1) - 1  # (T,) 0-based; may exceed C-1
+    kept = slot < C
+
+    # pack: send[e, c] = the c-th kept token routed to expert e
+    send = jnp.zeros((E, C, D), x.dtype)
+    scat_e = jnp.where(kept, expert, 0)
+    scat_c = jnp.where(kept, slot, 0)
+    send = send.at[scat_e, scat_c].add(
+        jnp.where(kept[:, None], x, 0), mode="drop"
+    )
+
+    # exchange: recv[s, c] = bucket sent BY shard s TO my expert
+    recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)
+    # expert FFN on every received token: (E, C, D) -> (E, C, D)
+    h = jax.nn.gelu(recv @ w_in.astype(recv.dtype))
+    y = h @ w_out.astype(recv.dtype)
+    # return to senders
+    back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
+                          tiled=False)  # (E, C, D): my tokens, per expert
+
+    # unpack: token t's result lives at back[expert[t], slot[t]]
+    out = back[scat_e, scat_c]  # (T, D)
+    out = jnp.where(kept[:, None], out, 0).astype(x.dtype)
+    out = out * gate[:, None].astype(x.dtype)
+
+    # switch aux loss: E * sum_e fraction_tokens_e * mean_prob_e, averaged
+    # over shards (identical formula on every shard after the pmean)
+    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    mean_prob = jnp.mean(probs, axis=0)
+    aux = E * jnp.sum(frac * mean_prob)
+    aux = lax.pmean(aux, axis_name)
+    return out, aux

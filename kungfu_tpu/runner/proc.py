@@ -15,24 +15,33 @@ from typing import Dict, List, Optional
 
 _COLORS = [31, 32, 33, 34, 35, 36, 91, 92, 93, 94, 95, 96]
 
+# Orphan protection: children get SIGTERM when the runner dies
+# (PR_SET_PDEATHSIG), so a hard-killed runner (SIGKILL, OOM) cannot leave
+# workers or warm standbys lingering (an idle orphan can even pin the TPU
+# tunnel claim). The arming must NOT happen via preexec_fn — calling into
+# ctypes between fork and exec in a threaded runner deadlocks
+# intermittently on locks held by threads that don't exist in the child
+# (observed ~1/3 of spawns under a jax-threaded parent). Instead a tiny
+# exec shim (native/pdeathsig.c, built by native/build.sh) arms the
+# signal in a fresh single-threaded process and execvp's the real
+# command; python -m kungfu_tpu.runner.standby additionally arms itself
+# in-process, covering standbys even without the shim.
+_PDEATHSIG_SHIM = os.path.join(os.path.dirname(__file__), "kf-pdeathsig")
+_warned_no_shim = False
 
-def _die_with_parent() -> None:
-    """preexec_fn: SIGTERM this child when the runner dies
-    (PR_SET_PDEATHSIG). A hard-killed runner (SIGKILL, OOM) never reaches
-    its cleanup paths; without this, workers and warm standbys orphan —
-    and an idle orphan can even pin the TPU tunnel claim. Runs between
-    fork and exec, so there is no exec-to-prctl race. CDLL(None) resolves
-    prctl from the running process under any Linux libc (a hardcoded
-    libc.so.6 would silently no-op on musl)."""
-    try:
-        import ctypes
-        import signal as _signal
 
-        libc = ctypes.CDLL(None, use_errno=True)
-        PR_SET_PDEATHSIG = 1
-        libc.prctl(PR_SET_PDEATHSIG, _signal.SIGTERM, 0, 0, 0)
-    except Exception:  # noqa: BLE001 - non-Linux: best-effort only
-        pass
+def _shim_argv(argv: List[str]) -> List[str]:
+    if os.access(_PDEATHSIG_SHIM, os.X_OK):
+        return [_PDEATHSIG_SHIM] + list(argv)
+    global _warned_no_shim
+    if not _warned_no_shim and os.name == "posix":
+        _warned_no_shim = True
+        print(
+            "kfrun: kf-pdeathsig shim not built (native/build.sh); workers "
+            "will not be reaped if this runner is hard-killed",
+            file=sys.stderr,
+        )
+    return list(argv)
 
 
 def _color(i: int, s: str) -> str:
@@ -65,14 +74,15 @@ class WorkerProc:
     def start(self) -> None:
         full_env = dict(os.environ)
         full_env.update(self.env)
+        # explicit runner pid for the shim/standby died-before-arm check
+        full_env["KF_RUNNER_PID"] = str(os.getpid())
         self.proc = subprocess.Popen(
-            self.argv,
+            _shim_argv(self.argv),
             env=full_env,
             stdout=subprocess.PIPE,
             stderr=subprocess.PIPE,
             text=True,
             bufsize=1,
-            preexec_fn=_die_with_parent if os.name == "posix" else None,
         )
         if self.cpus:
             from kungfu_tpu.runner.affinity import apply_affinity

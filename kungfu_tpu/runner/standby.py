@@ -89,8 +89,14 @@ class StandbySlot:
             except OSError as e:
                 if e.errno not in (errno.ENXIO, errno.ENOENT):
                     raise
-                if not self.alive or time.time() >= deadline:
+                if not self.alive:
                     self._unlink_fifo()
+                    return False
+                if time.time() >= deadline:
+                    # the standby is alive but slow (python startup under
+                    # load): do NOT unlink — it has yet to open this path,
+                    # and removing it would crash a healthy standby. The
+                    # pool tempdir sweep owns cleanup for this case.
                     return False
                 time.sleep(0.05)
         try:
@@ -177,16 +183,40 @@ class StandbyPool:
         shutil.rmtree(self._dir, ignore_errors=True)
 
 
+def _die_with_parent() -> None:
+    """Arm PR_SET_PDEATHSIG in-process (safe: we are past exec, single-
+    threaded). Belt-and-braces with the kf-pdeathsig exec shim — this
+    covers standbys even when the shim binary hasn't been built."""
+    try:
+        import ctypes
+        import signal as _signal
+
+        libc = ctypes.CDLL(None, use_errno=True)
+        libc.prctl(1, _signal.SIGTERM, 0, 0, 0)  # 1 = PR_SET_PDEATHSIG
+        # died-before-arm check against the EXPLICIT runner pid (a
+        # getppid()==1 heuristic misfires when the runner IS pid 1,
+        # e.g. a container entrypoint)
+        runner_pid = int(os.environ.get("KF_RUNNER_PID", "0"))
+        if runner_pid > 0 and os.getppid() != runner_pid:
+            sys.exit(0)  # runner died before the arm
+    except Exception:  # noqa: BLE001 - non-Linux: best-effort only
+        pass
+
+
 def main() -> None:
-    # orphan protection (PR_SET_PDEATHSIG) is applied by WorkerProc's
-    # preexec_fn, uniformly for standbys and cold-spawned workers
+    _die_with_parent()
     fifo = os.environ.get("KF_STANDBY_FIFO", "")
     if not fifo:
         print("kf-standby: KF_STANDBY_FIFO not set", file=sys.stderr)
         sys.exit(2)
     # open for reading BEFORE warming so the watcher's nonblocking
     # open-for-write succeeds from the moment we exist
-    fd = os.open(fifo, os.O_RDONLY | os.O_NONBLOCK)
+    try:
+        fd = os.open(fifo, os.O_RDONLY | os.O_NONBLOCK)
+    except FileNotFoundError:
+        # the pool already swept this slot (watcher teardown raced us)
+        print("kf-standby: fifo gone before open; exiting", file=sys.stderr)
+        sys.exit(0)
     # warm imports: the bulk of cold-join latency
     import numpy  # noqa: F401
 

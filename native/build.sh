@@ -7,3 +7,9 @@ CXX=${1:-g++}
 OUT=../kungfu_tpu/base/libkfnative.so
 $CXX -O3 -march=native -shared -fPIC -std=c++17 -o "$OUT" reduce.cpp mst.cpp
 echo "built $OUT"
+# exec shim arming PR_SET_PDEATHSIG for spawned workers (Linux only)
+if [ "$(uname -s)" = "Linux" ]; then
+    SHIM=../kungfu_tpu/runner/kf-pdeathsig
+    $CXX -O2 -o "$SHIM" pdeathsig.c
+    echo "built $SHIM"
+fi
