@@ -1,5 +1,6 @@
 from kungfu_tpu.parallel.mesh import DeviceSession, make_mesh
 from kungfu_tpu.parallel.dp import make_train_step
+from kungfu_tpu.parallel.pipeline import make_pp_transformer_loss
 from kungfu_tpu.parallel.distributed import (
     device_plane_initialized,
     initialize_device_plane,
@@ -10,6 +11,7 @@ from kungfu_tpu.parallel.distributed import (
 __all__ = [
     "DeviceSession",
     "make_mesh",
+    "make_pp_transformer_loss",
     "make_train_step",
     "initialize_device_plane",
     "reinitialize_device_plane",

@@ -76,14 +76,30 @@ class WorkerProc:
         full_env.update(self.env)
         # explicit runner pid for the shim/standby died-before-arm check
         full_env["KF_RUNNER_PID"] = str(os.getpid())
-        self.proc = subprocess.Popen(
-            _shim_argv(self.argv),
-            env=full_env,
-            stdout=subprocess.PIPE,
-            stderr=subprocess.PIPE,
-            text=True,
-            bufsize=1,
-        )
+        argv = _shim_argv(self.argv)
+        try:
+            self.proc = subprocess.Popen(
+                argv,
+                env=full_env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                bufsize=1,
+            )
+        except OSError:
+            if argv is self.argv or argv == list(self.argv):
+                raise
+            # the committed shim binary may not match this platform/arch
+            # (ENOEXEC): degrade to an unprotected spawn instead of
+            # failing the runner
+            self.proc = subprocess.Popen(
+                list(self.argv),
+                env=full_env,
+                stdout=subprocess.PIPE,
+                stderr=subprocess.PIPE,
+                text=True,
+                bufsize=1,
+            )
         if self.cpus:
             from kungfu_tpu.runner.affinity import apply_affinity
 

@@ -8,7 +8,9 @@ from jax.sharding import Mesh, PartitionSpec as P
 
 
 def _ep_mesh(ep):
-    return Mesh(np.array(jax.devices()[:ep]), ("ep",))
+    from kungfu_tpu.parallel import make_mesh
+
+    return make_mesh({"ep": ep}, devices=jax.devices()[:ep])
 
 
 def _dense_reference(x_all, router_w, w_in_all, w_out_all):

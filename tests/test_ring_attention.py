@@ -13,10 +13,7 @@ from kungfu_tpu.parallel import make_mesh
 
 
 def _sp_mesh(sp):
-    import numpy as _np
-    from jax.sharding import Mesh
-
-    return Mesh(_np.array(jax.devices()[:sp]), ("sp",))
+    return make_mesh({"sp": sp}, devices=jax.devices()[:sp])
 
 
 def _full_causal_attention(q, k, v):
