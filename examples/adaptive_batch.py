@@ -1,0 +1,96 @@
+"""GNS-driven adaptive batch size: gradient-noise-scale monitoring picks
+the cluster size (BASELINE config 5 — "elastic with resize_cluster +
+gradient-noise-scale adaptive batch on preemptible TPU VMs").
+
+The McCandlish critical batch size B_crit ~= GNS: while the measured GNS
+is well above the current GLOBAL batch (workers x per-worker batch),
+adding workers still buys near-linear speedup, so rank 0 proposes a
+bigger cluster; when GNS falls toward the global batch, growth stops.
+Run it:
+
+  kfrun -np 1 -H 127.0.0.1:4 -w -builtin-config-port 0 \\
+      python3 examples/adaptive_batch.py
+
+and watch the cluster grow as the noise estimate warms up.
+
+Host-plane variant for portability (the same wiring with the on-device
+`monitor_gradient_noise_scale` optimizer applies on a TPU mesh): per-step
+the gradient noise scale is estimated from the per-worker vs averaged
+gradient norms, exactly the McCandlish small/big-batch pair the
+reference's NoiseScale op consumes (srcs/cpp/src/op/noise_scale —
+capability parity: P9/MonitorGradientNoiseScaleOptimizer + policy-driven
+resize)."""
+
+import argparse
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.elastic import ElasticState
+
+
+def main():
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=120)
+    p.add_argument("--batch", type=int, default=32, help="per-worker batch")
+    p.add_argument("--max-workers", type=int, default=4)
+    p.add_argument("--alpha", type=float, default=0.7, help="GNS EMA")
+    args = p.parse_args()
+
+    rng = np.random.default_rng(1234 + api.current_rank())
+    dim = 256
+    w_true = np.random.default_rng(7).normal(size=(dim,))
+    w = np.zeros(dim)
+
+    es = ElasticState(max_progress=args.steps)
+    g2_ema, s_ema = 0.0, 0.0
+    lr = 0.05
+
+    while not es.stopped():
+        with es.scope():
+            size = api.cluster_size()
+            rank = api.current_rank()
+            # noisy linear-regression gradient on this worker's batch
+            x = rng.normal(size=(args.batch, dim))
+            noise = rng.normal(size=args.batch) * 3.0
+            err = x @ w - (x @ w_true + noise)
+            g_local = x.T @ err / args.batch
+
+            g_avg = api.all_reduce_array(g_local, name="grad") / size
+            # McCandlish pair from within-worker HALF batches (works even
+            # at cluster size 1, where per-worker vs average degenerates):
+            # |g_small|^2 over half-batch grads, |g_big|^2 of the cluster
+            # average
+            h = args.batch // 2
+            g_h1 = x[:h].T @ err[:h] / h
+            g_h2 = x[h:].T @ err[h:] / (args.batch - h)
+            local_gs = 0.5 * (g_h1 @ g_h1 + g_h2 @ g_h2)
+            gs = float(api.all_reduce_array(
+                np.array([local_gs]), name="gs")[0]) / size
+            gb = float(g_avg @ g_avg)
+            b_small, b_big = h, args.batch * size
+            if b_big > b_small:
+                s = (gs - gb) * b_small * b_big / (b_big - b_small)
+                g2 = (b_big * gb - b_small * gs) / (b_big - b_small)
+                g2_ema = args.alpha * g2_ema + (1 - args.alpha) * max(g2, 1e-12)
+                s_ema = args.alpha * s_ema + (1 - args.alpha) * max(s, 0.0)
+            gns = s_ema / g2_ema if g2_ema > 0 else 0.0
+
+            w -= lr * g_avg
+            step = es.progress
+            if rank == 0 and step % 10 == 9:
+                global_batch = args.batch * size
+                print(f"step {step}: size={size} gns={gns:.0f} "
+                      f"global_batch={global_batch}", flush=True)
+                # grow while the critical batch exceeds what we have
+                if gns > 2 * global_batch and size < args.max_workers:
+                    print(f"step {step}: proposing size {size + 1}", flush=True)
+                    api.propose_new_size(size + 1)
+            es.end(1)
+
+    print(f"done rank={api.current_rank()} size={api.cluster_size()} "
+          f"reason={es.stop_reason}", flush=True)
+
+
+if __name__ == "__main__":
+    main()

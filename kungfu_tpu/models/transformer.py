@@ -146,8 +146,20 @@ def _block(x, layer, cfg: TransformerConfig, core=_full_attention_core):
     return x + h @ layer["w_out"].astype(dt)
 
 
-def transformer_apply(params, tokens, cfg: TransformerConfig):
-    """tokens (B, S) int32 -> logits (B, S, V) in f32."""
+def lm_head_loss(params, x, targets, cfg: TransformerConfig):
+    """Final norm + tied-embedding LM head + next-token cross-entropy on
+    hidden states `x` (..., S, D). The ONE implementation shared by the
+    dense, ring (sequence-parallel) and pipeline paths — a loss change
+    (label smoothing, z-loss, dtype policy) lands everywhere at once."""
+    h = _rmsnorm(x, params["ln_f_scale"])
+    logits = h.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+    logp = jax.nn.log_softmax(logits)
+    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+    return -jnp.mean(ll)
+
+
+def transformer_hidden(params, tokens, cfg: TransformerConfig):
+    """tokens (B, S) int32 -> final hidden states (B, S, D) pre-norm."""
     B, S = tokens.shape
     dt = cfg.dtype
     x = params["embed"].astype(dt)[tokens] + params["pos_embed"].astype(dt)[:S]
@@ -156,9 +168,14 @@ def transformer_apply(params, tokens, cfg: TransformerConfig):
         return _block(x, layer, cfg), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
+    return x
+
+
+def transformer_apply(params, tokens, cfg: TransformerConfig):
+    """tokens (B, S) int32 -> logits (B, S, V) in f32."""
+    x = transformer_hidden(params, tokens, cfg)
     x = _rmsnorm(x, params["ln_f_scale"])
-    logits = x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
-    return logits
+    return x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
 
 
 def transformer_loss(params, batch, cfg: TransformerConfig):
@@ -167,10 +184,8 @@ def transformer_loss(params, batch, cfg: TransformerConfig):
         tokens, targets = batch
     else:
         tokens, targets = batch[:, :-1], batch[:, 1:]
-    logits = transformer_apply(params, tokens, cfg)
-    logp = jax.nn.log_softmax(logits)
-    ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-    return -jnp.mean(ll)
+    x = transformer_hidden(params, tokens, cfg)
+    return lm_head_loss(params, x, targets, cfg)
 
 
 # ---------------------------------------------------------------------------
@@ -186,7 +201,8 @@ def transformer_loss(params, batch, cfg: TransformerConfig):
 def ring_transformer_apply_shard(params, tokens, cfg: TransformerConfig,
                                  sp_axis: str, sp_size: int):
     """Per-shard forward for shard_map: tokens (B, S_local) is this
-    device's sequence chunk; returns per-shard logits (B, S_local, V)."""
+    device's sequence chunk; returns per-shard pre-norm hidden states
+    (B, S_local, D) — feed them to lm_head_loss."""
     from kungfu_tpu.ops.ring_attention import ring_self_attention
 
     B, Sl = tokens.shape
@@ -211,8 +227,7 @@ def ring_transformer_apply_shard(params, tokens, cfg: TransformerConfig,
         return _block(x, layer, cfg, core=ring_core), None
 
     x, _ = jax.lax.scan(body, x, params["layers"])
-    x = _rmsnorm(x, params["ln_f_scale"])
-    return x.astype(jnp.float32) @ params["embed"].astype(jnp.float32).T
+    return x  # pre-final-norm hidden states, like transformer_hidden
 
 
 def make_ring_transformer_loss(cfg: TransformerConfig, mesh,
@@ -226,10 +241,8 @@ def make_ring_transformer_loss(cfg: TransformerConfig, mesh,
 
     def shard_loss(params, batch):
         tokens, targets = batch
-        logits = ring_transformer_apply_shard(params, tokens, cfg, sp_axis, sp_size)
-        logp = jax.nn.log_softmax(logits)
-        ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-        loss = -jnp.mean(ll)
+        x = ring_transformer_apply_shard(params, tokens, cfg, sp_axis, sp_size)
+        loss = lm_head_loss(params, x, targets, cfg)
         return jax.lax.pmean(jax.lax.pmean(loss, sp_axis), dp_axis)
 
     return shard_map(

@@ -34,7 +34,7 @@ def make_pp_transformer_loss(cfg, mesh, n_micro: int, pp_axis: str = "pp",
     batch = (tokens, targets), both (B, S); B divisible by n_micro (and by
     the dp axis when given). Returns loss_fn(params, batch) -> replicated
     scalar, jit/grad-compatible."""
-    from kungfu_tpu.models.transformer import _block, _rmsnorm
+    from kungfu_tpu.models.transformer import _block, lm_head_loss
 
     n_stages = mesh.shape[pp_axis]
     if cfg.n_layers % n_stages:
@@ -52,7 +52,6 @@ def make_pp_transformer_loss(cfg, mesh, n_micro: int, pp_axis: str = "pp",
         dt = cfg.dtype
         embed = params["embed"].astype(dt)
         pos = params["pos_embed"].astype(dt)[:S]
-        embed_f32 = params["embed"].astype(jnp.float32)
         micro_tok = tokens.reshape(n_micro, b, S)
         micro_tgt = targets.reshape(n_micro, b, S)
 
@@ -77,11 +76,7 @@ def make_pp_transformer_loss(cfg, mesh, n_micro: int, pp_axis: str = "pp",
             m_out = t - (n_stages - 1)
             valid = (m_out >= 0) & (m_out < n_micro)
             tgt = micro_tgt[jnp.clip(m_out, 0, n_micro - 1)]
-            h = _rmsnorm(x, params["ln_f_scale"])
-            logits = h.astype(jnp.float32) @ embed_f32.T
-            logp = jax.nn.log_softmax(logits)
-            ll = jnp.take_along_axis(logp, tgt[..., None], axis=-1)
-            l = -jnp.mean(ll)
+            l = lm_head_loss(params, x, tgt, cfg)
             loss_acc = loss_acc + jnp.where(is_last & valid, l, 0.0)
             act_out = (
                 lax.ppermute(x, pp_axis, shift) if n_stages > 1 else x

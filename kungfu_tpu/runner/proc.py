@@ -28,10 +28,11 @@ _COLORS = [31, 32, 33, 34, 35, 36, 91, 92, 93, 94, 95, 96]
 # in-process, covering standbys even without the shim.
 _PDEATHSIG_SHIM = os.path.join(os.path.dirname(__file__), "kf-pdeathsig")
 _warned_no_shim = False
+_shim_broken = False  # set after the first exec failure: skip doomed retries
 
 
 def _shim_argv(argv: List[str]) -> List[str]:
-    if os.access(_PDEATHSIG_SHIM, os.X_OK):
+    if not _shim_broken and os.access(_PDEATHSIG_SHIM, os.X_OK):
         return [_PDEATHSIG_SHIM] + list(argv)
     global _warned_no_shim
     if not _warned_no_shim and os.name == "posix":
@@ -86,12 +87,20 @@ class WorkerProc:
                 text=True,
                 bufsize=1,
             )
-        except OSError:
+        except OSError as e:
             if argv is self.argv or argv == list(self.argv):
                 raise
             # the committed shim binary may not match this platform/arch
             # (ENOEXEC): degrade to an unprotected spawn instead of
-            # failing the runner
+            # failing the runner — loudly, and only once per process
+            global _shim_broken
+            if not _shim_broken:
+                _shim_broken = True
+                print(
+                    f"kfrun: kf-pdeathsig unusable ({e}); spawning workers "
+                    "WITHOUT orphan protection (rebuild via native/build.sh)",
+                    file=sys.stderr,
+                )
             self.proc = subprocess.Popen(
                 list(self.argv),
                 env=full_env,

@@ -67,6 +67,13 @@ def test_pp_composes_with_dp():
     pipe = float(jax.jit(loss_fn)(params, batch))
     # dp shards the batch; per-shard micro means averaged = global mean
     assert abs(dense - pipe) < 1e-5, (dense, pipe)
+    # gradients too: the subtle transpose path is the dp pmean composed
+    # with pp-sharded layer params under shard_map
+    g_pipe = jax.grad(lambda p: loss_fn(p, batch))(params)
+    g_dense = jax.grad(lambda p: transformer_loss(p, batch, cfg))(params)
+    for a, b in zip(jax.tree.leaves(g_pipe), jax.tree.leaves(g_dense)):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=2e-4, atol=2e-5)
 
 
 def test_pp_trains():
