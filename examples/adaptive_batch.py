@@ -40,16 +40,21 @@ def main():
     rng = np.random.default_rng(1234 + api.current_rank())
     dim = 256
     w_true = np.random.default_rng(7).normal(size=(dim,))
-    w = np.zeros(dim)
 
+    # live state (weights + GNS EMAs): joiners inherit it from a survivor
+    # via the ElasticState re-sync broadcast — a fresh-zeros joiner would
+    # break the S-SGD identical-params invariant AND poison the GNS
+    # estimate that drives resizing
+    state = {"w": np.zeros(dim), "emas": np.zeros(2)}
     es = ElasticState(max_progress=args.steps)
-    g2_ema, s_ema = 0.0, 0.0
+    es.register_state(lambda: state, lambda t: state.update(t))
     lr = 0.05
 
     while not es.stopped():
         with es.scope():
             size = api.cluster_size()
             rank = api.current_rank()
+            w = state["w"]
             # noisy linear-regression gradient on this worker's batch
             x = rng.normal(size=(args.batch, dim))
             noise = rng.normal(size=args.batch) * 3.0
@@ -69,14 +74,16 @@ def main():
                 np.array([local_gs]), name="gs")[0]) / size
             gb = float(g_avg @ g_avg)
             b_small, b_big = h, args.batch * size
+            g2_ema, s_ema = state["emas"]
             if b_big > b_small:
                 s = (gs - gb) * b_small * b_big / (b_big - b_small)
                 g2 = (b_big * gb - b_small * gs) / (b_big - b_small)
                 g2_ema = args.alpha * g2_ema + (1 - args.alpha) * max(g2, 1e-12)
                 s_ema = args.alpha * s_ema + (1 - args.alpha) * max(s, 0.0)
+                state["emas"] = np.array([g2_ema, s_ema])
             gns = s_ema / g2_ema if g2_ema > 0 else 0.0
 
-            w -= lr * g_avg
+            state["w"] = w - lr * g_avg
             step = es.progress
             if rank == 0 and step % 10 == 9:
                 global_batch = args.batch * size

@@ -124,13 +124,15 @@ def main():
             print(f"step {step}: g_loss {float(gl):.3f} d_loss {float(dl):.3f}",
                   flush=True)
 
-    # quality probe: G should map the X blob near the Y blob's center
+    # quality probe: G should map the X blob near the Y blob's center.
+    # Barrier BEFORE the assert: a rank failing the probe must not leave
+    # peers wedged inside the barrier
+    api.run_barrier()
     probe = sample_x(np.random.default_rng(9), 512)
     center = np.asarray(jnp.mean(mlp_apply(params["g"], probe), axis=0))
     err = float(np.linalg.norm(center - np.array([2.0, 1.0])))
     print(f"rank {rank}: G(X) center {center.round(2)} err {err:.2f}", flush=True)
     assert err < 1.0, f"generator failed to reach domain Y: {err}"
-    api.run_barrier()
     print(f"rank {rank}: cyclegan pair-averaging OK", flush=True)
 
 

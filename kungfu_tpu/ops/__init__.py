@@ -13,6 +13,7 @@ from kungfu_tpu.ops.hierarchical import (
     make_hier_train_step,
     synchronous_sgd_hierarchical,
 )
+from kungfu_tpu.ops.flash_attention import flash_attention
 from kungfu_tpu.ops.moe import switch_moe
 from kungfu_tpu.ops.ring_attention import ring_self_attention
 
@@ -30,4 +31,5 @@ __all__ = [
     "synchronous_sgd_hierarchical",
     "ring_self_attention",
     "switch_moe",
+    "flash_attention",
 ]

@@ -88,11 +88,16 @@ class WorkerProc:
                 bufsize=1,
             )
         except OSError as e:
+            import errno as _errno
+
             if argv is self.argv or argv == list(self.argv):
                 raise
-            # the committed shim binary may not match this platform/arch
-            # (ENOEXEC): degrade to an unprotected spawn instead of
-            # failing the runner — loudly, and only once per process
+            if e.errno not in (_errno.ENOEXEC, _errno.EACCES, _errno.ENOENT):
+                # transient spawn failure (EMFILE/ENOMEM/EAGAIN): NOT the
+                # shim's fault — surface it, don't latch protection off
+                raise
+            # the committed shim binary doesn't run on this platform/arch:
+            # degrade to unprotected spawns — loudly, and only once
             global _shim_broken
             if not _shim_broken:
                 _shim_broken = True
