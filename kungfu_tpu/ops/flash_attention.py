@@ -38,6 +38,55 @@ def _dense_reference(q, k, v, causal: bool, sm_scale: float):
     return jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32)).astype(q.dtype)
 
 
+def _chunked_reference(q, k, v, causal: bool, sm_scale: float,
+                       blk_k: int = 512):
+    """Differentiable online-softmax attention as a lax.scan over K/V
+    blocks, each scan step rematerialized (jax.checkpoint): identical
+    math to the dense formulation, but the (S, S) score tensor never
+    exists in either the forward OR the saved-residual set — the flash
+    backward runs through jax.vjp of THIS, keeping training memory
+    O(S x BLK_K) per head."""
+    B, H, S, hd = q.shape
+    blk_k = min(blk_k, S)
+    if S % blk_k:
+        return _dense_reference(q, k, v, causal, sm_scale)
+    qf = q.astype(jnp.float32)
+    n_kb = S // blk_k
+    kb_ = k.reshape(B, H, n_kb, blk_k, hd).transpose(2, 0, 1, 3, 4)
+    vb_ = v.reshape(B, H, n_kb, blk_k, hd).transpose(2, 0, 1, 3, 4)
+    qpos = lax.broadcasted_iota(jnp.int32, (S, blk_k), 0)
+
+    @jax.checkpoint
+    def body(carry, inp):
+        m, l, acc = carry
+        kb, vb, kb_idx = inp
+        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * sm_scale
+        if causal:
+            kpos = kb_idx * blk_k + lax.broadcasted_iota(
+                jnp.int32, (S, blk_k), 1
+            )
+            mask = kpos <= qpos
+            s = jnp.where(mask, s, NEG_INF)
+            maskf = mask.astype(jnp.float32)
+        else:
+            maskf = 1.0
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new) * maskf
+        corr = jnp.exp(m - m_new)
+        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p,
+                                      vb.astype(jnp.float32))
+        return (m_new, l, acc), None
+
+    m0 = jnp.full((B, H, S, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
+    acc0 = jnp.zeros((B, H, S, hd), jnp.float32)
+    (m, l, acc), _ = lax.scan(
+        body, (m0, l0, acc0), (kb_, vb_, jnp.arange(n_kb))
+    )
+    return (acc / l).astype(q.dtype)
+
+
 def _kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
             sm_scale: float):
     from jax.experimental import pallas as pl
@@ -147,8 +196,11 @@ def _bwd(causal, sm_scale, blk_q, blk_k, interpret, res, g):
     q, k, v = res
     if sm_scale is None:
         sm_scale = 1.0 / (q.shape[-1] ** 0.5)
+    # memory-efficient backward: vjp through the remat-chunked formulation
+    # (identical math; no (S, S) tensor in residuals or recompute)
     _, vjp = jax.vjp(
-        lambda q, k, v: _dense_reference(q, k, v, causal, sm_scale), q, k, v
+        lambda q, k, v: _chunked_reference(q, k, v, causal, sm_scale, blk_k),
+        q, k, v,
     )
     return vjp(g)
 
