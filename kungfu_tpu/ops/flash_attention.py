@@ -8,9 +8,9 @@ instead of O(S^2). Causal programs stop at their diagonal block (the
 upper-triangular half is never computed at all).
 
 Differentiable via custom_vjp: the forward runs the kernel; the backward
-recomputes attention with the dense formulation under jax.vjp (correct
-everywhere; a fused flash backward kernel is a further optimization, not
-a semantic difference).
+differentiates a q-chunk-mapped, per-chunk-rematerialized formulation
+(`_chunked_reference`) — identical math, and neither the forward nor the
+backward ever holds an (S, S) tensor or a quadratic residual set.
 
 Off-TPU the kernel runs in interpret mode so the same code path is
 testable on the CPU meshes used by this repo's test suite.
@@ -39,105 +39,134 @@ def _dense_reference(q, k, v, causal: bool, sm_scale: float):
 
 
 def _chunked_reference(q, k, v, causal: bool, sm_scale: float,
-                       blk_k: int = 512):
-    """Differentiable online-softmax attention as a lax.scan over K/V
-    blocks, each scan step rematerialized (jax.checkpoint): identical
-    math to the dense formulation, but the (S, S) score tensor never
-    exists in either the forward OR the saved-residual set — the flash
-    backward runs through jax.vjp of THIS, keeping training memory
-    O(S x BLK_K) per head."""
+                       blk_q: int = 512, blk_k: int = 512):
+    """Differentiable online-softmax attention with bounded memory:
+    `lax.map` over Q-CHUNKS, each chunk wrapped in `jax.checkpoint`.
+
+    Per chunk, an inner k-block scan runs the flash recurrence; the
+    checkpoint boundary means the outer map's saved residuals are just
+    the chunk inputs (O(S x hd) total), and the inner scan's per-step
+    carries exist only transiently during that chunk's backward
+    (O(S/blk_k x blk_q x hd)). Scanning k-blocks at FULL q (the naive
+    layout) would be wrong: scan's VJP saves the (S, hd) acc carry per
+    k-step — Theta(S^2 hd / blk_k), a quadratic bill hidden in
+    residuals. The flash backward runs through jax.vjp of this."""
     B, H, S, hd = q.shape
+    blk_q = min(blk_q, S)
     blk_k = min(blk_k, S)
-    if S % blk_k:
+    if S % blk_q or S % blk_k:
         return _dense_reference(q, k, v, causal, sm_scale)
-    qf = q.astype(jnp.float32)
-    n_kb = S // blk_k
-    kb_ = k.reshape(B, H, n_kb, blk_k, hd).transpose(2, 0, 1, 3, 4)
-    vb_ = v.reshape(B, H, n_kb, blk_k, hd).transpose(2, 0, 1, 3, 4)
-    qpos = lax.broadcasted_iota(jnp.int32, (S, blk_k), 0)
+    n_qb, n_kb = S // blk_q, S // blk_k
+    kf = k.astype(jnp.float32)
+    vf = v.astype(jnp.float32)
+    kb_ = kf.reshape(B, H, n_kb, blk_k, hd).transpose(2, 0, 1, 3, 4)
+    vb_ = vf.reshape(B, H, n_kb, blk_k, hd).transpose(2, 0, 1, 3, 4)
 
     @jax.checkpoint
-    def body(carry, inp):
-        m, l, acc = carry
-        kb, vb, kb_idx = inp
-        s = jnp.einsum("bhqd,bhkd->bhqk", qf, kb.astype(jnp.float32)) * sm_scale
-        if causal:
-            kpos = kb_idx * blk_k + lax.broadcasted_iota(
-                jnp.int32, (S, blk_k), 1
-            )
-            mask = kpos <= qpos
-            s = jnp.where(mask, s, NEG_INF)
-            maskf = mask.astype(jnp.float32)
-        else:
-            maskf = 1.0
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
-        p = jnp.exp(s - m_new) * maskf
-        corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p,
-                                      vb.astype(jnp.float32))
-        return (m_new, l, acc), None
+    def one_chunk(args):
+        qc, q_off = args  # (B, H, blk_q, hd), scalar block offset
+        qcf = qc.astype(jnp.float32)
+        qpos = q_off + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
 
-    m0 = jnp.full((B, H, S, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((B, H, S, 1), jnp.float32)
-    acc0 = jnp.zeros((B, H, S, hd), jnp.float32)
-    (m, l, acc), _ = lax.scan(
-        body, (m0, l0, acc0), (kb_, vb_, jnp.arange(n_kb))
-    )
-    return (acc / l).astype(q.dtype)
+        def body(carry, inp):
+            m, l, acc = carry
+            kb, vb, kb_idx = inp
+            s = jnp.einsum("bhqd,bhkd->bhqk", qcf, kb) * sm_scale
+            if causal:
+                kpos = kb_idx * blk_k + lax.broadcasted_iota(
+                    jnp.int32, (blk_q, blk_k), 1
+                )
+                mask = kpos <= qpos
+                s = jnp.where(mask, s, NEG_INF)
+                maskf = mask.astype(jnp.float32)
+            else:
+                maskf = 1.0
+            m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+            p = jnp.exp(s - m_new) * maskf
+            corr = jnp.exp(m - m_new)
+            l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
+            acc = acc * corr + jnp.einsum("bhqk,bhkd->bhqd", p, vb)
+            return (m_new, l, acc), None
+
+        m0 = jnp.full((B, H, blk_q, 1), NEG_INF, jnp.float32)
+        l0 = jnp.zeros((B, H, blk_q, 1), jnp.float32)
+        acc0 = jnp.zeros((B, H, blk_q, hd), jnp.float32)
+        (_, l, acc), _ = lax.scan(
+            body, (m0, l0, acc0), (kb_, vb_, jnp.arange(n_kb))
+        )
+        return acc / l
+
+    q_chunks = q.reshape(B, H, n_qb, blk_q, hd).transpose(2, 0, 1, 3, 4)
+    offsets = jnp.arange(n_qb) * blk_q
+    out = lax.map(one_chunk, (q_chunks, offsets))  # (n_qb, B, H, blk_q, hd)
+    out = out.transpose(1, 2, 0, 3, 4).reshape(B, H, S, hd)
+    return out.astype(q.dtype)
 
 
-def _kernel(q_ref, k_ref, v_ref, o_ref, *, blk_k: int, causal: bool,
-            sm_scale: float):
+def _kernel(q_ref, k_ref, v_ref, o_ref, m_scr, l_scr, acc_scr, *,
+            blk_q: int, blk_k: int, causal: bool, sm_scale: float):
+    """One (bh, q-block, k-block) grid program. The TPU grid runs the
+    LAST dimension sequentially on one core, so the (m, l, acc) flash
+    accumulators live in VMEM scratch across the k-block sweep; K/V
+    arrive one block at a time via BlockSpec streaming — VMEM holds
+    O(blk) state regardless of S."""
     from jax.experimental import pallas as pl
 
-    q = q_ref[0].astype(jnp.float32)  # (BLK_Q, hd)
-    blk_q, hd = q.shape
-    S = k_ref.shape[1]
+    kb = pl.program_id(2)
     qi = pl.program_id(1)
+    n_kb = pl.num_programs(2)
     q_off = qi * blk_q
+    k_off = kb * blk_k
 
-    n_kb = S // blk_k
-    if causal:
-        # stop at the diagonal block: keys beyond q_off + blk_q - 1 are
-        # always masked
-        n_kb_eff = lax.min(n_kb, (q_off + blk_q + blk_k - 1) // blk_k)
-    else:
-        n_kb_eff = n_kb
+    @pl.when(kb == 0)
+    def _init():
+        m_scr[...] = jnp.full_like(m_scr[...], NEG_INF)
+        l_scr[...] = jnp.zeros_like(l_scr[...])
+        acc_scr[...] = jnp.zeros_like(acc_scr[...])
 
-    qpos = q_off + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+    # causal: blocks fully above the diagonal contribute nothing
+    live = (k_off <= q_off + blk_q - 1) if causal else (kb >= 0)
 
-    def body(kb, carry):
-        m, l, acc = carry
-        k = k_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
-        v = v_ref[0, pl.ds(kb * blk_k, blk_k), :].astype(jnp.float32)
+    @pl.when(live)
+    def _compute():
+        q = q_ref[0].astype(jnp.float32)
+        k = k_ref[0].astype(jnp.float32)
+        v = v_ref[0].astype(jnp.float32)
         s = jnp.dot(q, k.T, preferred_element_type=jnp.float32) * sm_scale
         if causal:
-            kpos = kb * blk_k + lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1
-            )
+            qpos = q_off + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            kpos = k_off + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
             mask = kpos <= qpos
             s = jnp.where(mask, s, NEG_INF)
             maskf = mask.astype(jnp.float32)
         else:
             maskf = 1.0
+        m = m_scr[:, :1]
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new) * maskf
         corr = jnp.exp(m - m_new)
-        l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        acc = acc * corr + jnp.dot(p, v, preferred_element_type=jnp.float32)
-        return m_new, l, acc
+        l_scr[:, :1] = l_scr[:, :1] * corr + jnp.sum(p, axis=-1, keepdims=True)
+        acc_scr[...] = acc_scr[...] * corr + jnp.dot(
+            p, v, preferred_element_type=jnp.float32
+        )
+        m_scr[:, :1] = m_new
 
-    m0 = jnp.full((blk_q, 1), NEG_INF, jnp.float32)
-    l0 = jnp.zeros((blk_q, 1), jnp.float32)
-    acc0 = jnp.zeros((blk_q, hd), jnp.float32)
-    _, l, acc = lax.fori_loop(0, n_kb_eff, body, (m0, l0, acc0))
-    o_ref[0] = (acc / l).astype(o_ref.dtype)
+    @pl.when(kb == n_kb - 1)
+    def _finalize():
+        o_ref[0] = (acc_scr[...] / l_scr[:, :1]).astype(o_ref.dtype)
+
+
+def _kv_index(blk_q, blk_k, causal, b, i, j):
+    if not causal:
+        return (b, j, 0)
+    diag = (i * blk_q + blk_q - 1) // blk_k  # last live k-block for q-block i
+    return (b, jnp.minimum(j, diag), 0)
 
 
 def _forward(q, k, v, causal: bool, sm_scale: float, blk_q: int,
              blk_k: int, interpret) -> jnp.ndarray:
     from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
 
     B, H, S, hd = q.shape
     blk_q = min(blk_q, S)
@@ -151,16 +180,25 @@ def _forward(q, k, v, causal: bool, sm_scale: float, blk_q: int,
     kf = k.reshape(B * H, S, hd)
     vf = v.reshape(B * H, S, hd)
     out = pl.pallas_call(
-        functools.partial(_kernel, blk_k=blk_k, causal=causal,
+        functools.partial(_kernel, blk_q=blk_q, blk_k=blk_k, causal=causal,
                           sm_scale=sm_scale),
         out_shape=jax.ShapeDtypeStruct((B * H, S, hd), q.dtype),
-        grid=(B * H, S // blk_q),
+        grid=(B * H, S // blk_q, S // blk_k),
         in_specs=[
-            pl.BlockSpec((1, blk_q, hd), lambda b, i: (b, i, 0)),
-            pl.BlockSpec((1, S, hd), lambda b, i: (b, 0, 0)),
-            pl.BlockSpec((1, S, hd), lambda b, i: (b, 0, 0)),
+            pl.BlockSpec((1, blk_q, hd), lambda b, i, j: (b, i, 0)),
+            # causal: clamp the K/V block index at the q-block's diagonal
+            # so dead above-diagonal blocks repeat the previous index and
+            # Pallas skips their HBM fetch entirely (pl.when already
+            # skips their compute)
+            pl.BlockSpec((1, blk_k, hd), functools.partial(_kv_index, blk_q, blk_k, causal)),
+            pl.BlockSpec((1, blk_k, hd), functools.partial(_kv_index, blk_q, blk_k, causal)),
         ],
-        out_specs=pl.BlockSpec((1, blk_q, hd), lambda b, i: (b, i, 0)),
+        out_specs=pl.BlockSpec((1, blk_q, hd), lambda b, i, j: (b, i, 0)),
+        scratch_shapes=[
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # m (lane-replicated col 0)
+            pltpu.VMEM((blk_q, 128), jnp.float32),  # l
+            pltpu.VMEM((blk_q, hd), jnp.float32),  # acc
+        ],
         interpret=interpret,
     )(qf, kf, vf)
     return out.reshape(B, H, S, hd)
