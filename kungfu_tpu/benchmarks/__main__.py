@@ -72,14 +72,44 @@ def bench_host(model: str, iters: int) -> None:
         )
 
 
+def bench_p2p(model: str, iters: int) -> None:
+    """p2p model-request throughput (parity: kungfu-bench-p2p,
+    tests/go/cmd/ — each worker fetches its ring neighbour's published
+    model from the versioned store)."""
+    from kungfu_tpu import api
+    from kungfu_tpu.models.fake import fake_gradients
+
+    blob = b"".join(g.tobytes() for g in fake_gradients(model))
+    rank, size = api.current_rank(), api.cluster_size()
+    api.save("bench-model", blob, version=0)
+    api.run_barrier()
+    peer = (rank + 1) % size
+    samples = []
+    for i in range(iters):
+        t0 = time.perf_counter()
+        got = api.request(peer, "bench-model", version="latest")
+        dt = time.perf_counter() - t0
+        assert got is not None and len(got) == len(blob)
+        samples.append(len(blob) / dt / (1 << 30))
+    api.run_barrier()
+    mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
+    if rank == 0:
+        print(
+            f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) "
+            f"[P2P x{size} workers, {model}]"
+        )
+
+
 def main() -> None:
     p = argparse.ArgumentParser("kungfu_tpu.benchmarks")
-    p.add_argument("--method", choices=["XLA", "HOST"], default="XLA")
+    p.add_argument("--method", choices=["XLA", "HOST", "P2P"], default="XLA")
     p.add_argument("--model", default="resnet50-imagenet")
     p.add_argument("--iters", type=int, default=10)
     args = p.parse_args()
     if args.method == "XLA":
         bench_xla(args.model, args.iters)
+    elif args.method == "P2P":
+        bench_p2p(args.model, args.iters)
     else:
         bench_host(args.model, args.iters)
 

@@ -295,17 +295,33 @@ class P2PEndpoint:
         if msg.flags & Flags.IS_RESPONSE:
             self._rdv.put(src, msg)
             return
-        # incoming request: look up blob, respond
-        data = self._lookup(msg.name)
-        if data is None:
-            self.client.send(
-                src, msg.name, b"", ConnType.PEER_TO_PEER,
-                Flags.IS_RESPONSE | Flags.REQUEST_FAILED,
-            )
-        else:
-            self.client.send(
-                src, msg.name, data, ConnType.PEER_TO_PEER, Flags.IS_RESPONSE
-            )
+        # Incoming request: respond OFF the transport read thread. A
+        # blocking sendall of a large blob here stops this connection's
+        # reads; two peers requesting each other's model simultaneously
+        # then deadlock once TCP buffers fill (each side mid-send, nobody
+        # reading). Parity: the reference answers requests from worker
+        # goroutines while connection readers keep draining.
+        from kungfu_tpu.utils.pool import get_pool
+
+        name = msg.name
+        get_pool().submit(lambda: self._respond(src, name))
+
+    def _respond(self, src: PeerID, name: str) -> None:
+        data = self._lookup(name)
+        try:
+            if data is None:
+                self.client.send(
+                    src, name, b"", ConnType.PEER_TO_PEER,
+                    Flags.IS_RESPONSE | Flags.REQUEST_FAILED,
+                )
+            else:
+                self.client.send(
+                    src, name, data, ConnType.PEER_TO_PEER, Flags.IS_RESPONSE
+                )
+        except (ConnectionError, OSError):
+            # requester vanished (elastic shrink): their retry/timeout
+            # handles it; the serving peer must not crash
+            pass
 
     def request(
         self,

@@ -246,3 +246,32 @@ def test_versioned_p2p_requests(peer_pair):
     r = threading.Thread(target=reader)
     w.start(); r.start(); r.join(60); stop.set(); w.join(10)
     assert not errs, errs
+
+
+def test_simultaneous_large_cross_requests_no_deadlock(peer_pair):
+    """Two peers requesting each other's LARGE blob at the same instant
+    must not send-send deadlock: responses are written off the transport
+    read thread, so reads keep draining while sends block (round-4 p2p
+    bench finding)."""
+    p0, p1 = peer_pair
+    blob = bytes(bytearray(20 * 1024 * 1024))  # 20 MB >> TCP buffers
+    p0.p2p.save_version(0, "big", blob)
+    p1.p2p.save_version(0, "big", blob)
+    results = {}
+
+    def fetch(me, other_peer, key):
+        try:
+            results[key] = me.p2p.request(other_peer, "big", timeout=60,
+                                          version="latest")
+        except Exception as e:  # noqa: BLE001 - surfaced by the asserts
+            results[key] = e
+
+    t0 = threading.Thread(target=fetch, args=(p0, p0.config.peers[1], "a"))
+    t1 = threading.Thread(target=fetch, args=(p1, p1.config.peers[0], "b"))
+    t0.start(); t1.start()
+    t0.join(90); t1.join(90)
+    assert not t0.is_alive() and not t1.is_alive(), "p2p cross-request deadlock"
+    for key in ("a", "b"):
+        got = results.get(key)
+        assert not isinstance(got, Exception), f"p2p cross-request deadlock: {got!r}"
+        assert got is not None and len(got) == len(blob)
