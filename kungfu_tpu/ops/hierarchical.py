@@ -44,10 +44,18 @@ class CrossSliceReducer:
     single division by the world count lands after the wire SUM (the
     reference's reduce-then-scale order)."""
 
-    def __init__(self, peer=None, name: str = "hier"):
+    def __init__(self, peer=None, name: str = "hier", compress: str = ""):
+        """compress="bf16": f32/f64 leaves cross the DCN wire as bfloat16
+        (half/quarter the bytes; the in-slice ICI psum stays full
+        precision, so only the CROSS-slice term is rounded — the standard
+        gradient-compression trade for bandwidth-bound DCN links).
+        Integer and already-half-precision leaves pass through."""
         self._peer = peer
         self.name = name
         self.step = 0
+        if compress not in ("", "bf16"):
+            raise ValueError(f"unknown compression {compress!r}")
+        self.compress = compress
 
     def _session(self):
         if self._peer is None:
@@ -64,6 +72,16 @@ class CrossSliceReducer:
         if n <= 1:
             return [np.asarray(l) for l in leaves]
         arrs = [np.ascontiguousarray(l) for l in leaves]
+        orig_dtypes = [a.dtype for a in arrs]
+        if self.compress == "bf16":
+            import ml_dtypes
+
+            arrs = [
+                a.astype(ml_dtypes.bfloat16)
+                if np.issubdtype(a.dtype, np.floating) and a.dtype.itemsize > 2
+                else a
+                for a in arrs
+            ]
         outs = [np.empty_like(a) for a in arrs]
         ws = [
             Workspace(
@@ -75,20 +93,27 @@ class CrossSliceReducer:
             for i, (a, o) in enumerate(zip(arrs, outs))
         ]
         sess.group_all_reduce(ws)
-        return [self._mean(o, n) for o in outs]
+        return [self._mean(o, n, dt) for o, dt in zip(outs, orig_dtypes)]
 
     @staticmethod
-    def _mean(o: np.ndarray, n: int) -> np.ndarray:
-        """sum/n preserving dtype. NOTE the check must be issubdtype(...,
+    def _mean(o: np.ndarray, n: int, out_dtype=None) -> np.ndarray:
+        """sum/n, cast ONCE to out_dtype (default: o's dtype) — the
+        compressed path divides the bf16 wire sum at f32 precision and
+        lands directly in the original f32/f64 without an intermediate
+        bf16 rounding. NOTE the branch check must be issubdtype(...,
         integer), not floating: ml_dtypes bfloat16 has numpy kind 'V', so
         a floating-check would send bf16 down the integer floor-division
         branch and zero out sub-1.0 gradient sums."""
+        if out_dtype is None:
+            out_dtype = o.dtype
         if np.issubdtype(o.dtype, np.integer):
-            return o // n
+            return (o // n).astype(out_dtype, copy=False)
         if o.dtype.itemsize < 4:
-            # bf16/f16/f8: divide at f32 precision, round once at the end
-            return (o.astype(np.float32) / np.float32(n)).astype(o.dtype)
-        return o / o.dtype.type(n)
+            # bf16/f16/f8 wire sums: divide at f32 precision
+            return (o.astype(np.float32) / np.float32(n)).astype(
+                out_dtype, copy=False
+            )
+        return (o / o.dtype.type(n)).astype(out_dtype, copy=False)
 
 
 def cross_slice_mean(tree, reducer: CrossSliceReducer):
@@ -126,6 +151,7 @@ def make_hier_train_step(
     name: str = "hier",
     batch_spec: Optional[P] = None,
     donate: bool = False,
+    compress: str = "",
 ):
     """One jitted S-SGD step with hierarchical gradient sync.
 
@@ -136,7 +162,7 @@ def make_hier_train_step(
     """
     from jax import shard_map
 
-    reducer = CrossSliceReducer(peer=peer, name=name)
+    reducer = CrossSliceReducer(peer=peer, name=name, compress=compress)
     bspec = batch_spec if batch_spec is not None else P(axis_name)
 
     def local_grads(params, batch):

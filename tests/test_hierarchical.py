@@ -119,3 +119,53 @@ def test_cross_slice_mean_dtypes():
     np.testing.assert_array_equal(m(np.array([5, 7], np.int32), 2), [2, 3])
     np.testing.assert_allclose(m(np.array([1.0, 3.0], np.float64), 2), [0.5, 1.5])
     assert m(np.array([1.0], np.float32), 4).dtype == np.float32
+
+
+def test_cross_slice_reducer_bf16_compression():
+    """compress="bf16": f32 leaves cross the wire as bf16 (half bytes),
+    come back as f32, values within bf16 rounding of the exact mean."""
+    import threading
+
+    from kungfu_tpu.ops.hierarchical import CrossSliceReducer
+    from tests.test_pair_averaging import make_peer_pair
+
+    p0, p1 = make_peer_pair()
+    try:
+        vals = {
+            0: np.linspace(-3, 3, 64, dtype=np.float32),
+            1: np.linspace(1, 7, 64, dtype=np.float32),
+        }
+        ints = np.arange(4, dtype=np.int32)
+        expect = (vals[0] + vals[1]) / 2
+        out, errs = {}, []
+
+        def run(rank, peer):
+            try:
+                r = CrossSliceReducer(peer=peer, compress="bf16")
+                out[rank] = r(vals[rank], ints)
+            except Exception as e:  # noqa: BLE001
+                errs.append(e)
+
+        ts = [threading.Thread(target=run, args=(r, p))
+              for r, p in ((0, p0), (1, p1))]
+        for t in ts:
+            t.start()
+        for t in ts:
+            t.join(60)
+        assert not errs, errs
+        for rank in (0, 1):
+            f, i = out[rank]
+            assert f.dtype == np.float32  # restored to the input dtype
+            np.testing.assert_allclose(f, expect, rtol=2e-2, atol=2e-2)
+            # ints pass through uncompressed and exact
+            np.testing.assert_array_equal(i, ints)  # mean of equal ints
+    finally:
+        p0.stop()
+        p1.stop()
+
+
+def test_cross_slice_reducer_rejects_unknown_compression():
+    from kungfu_tpu.ops.hierarchical import CrossSliceReducer
+
+    with pytest.raises(ValueError, match="unknown compression"):
+        CrossSliceReducer(compress="int8")
