@@ -37,12 +37,16 @@ def make_peer_pair(port0=None, port1=None):
             init_progress=0,
         )
         out.append(Peer(cfg))
-    # start concurrently (start() barriers)
+    # start concurrently (start() barriers); generous deadline — under
+    # full-suite load on the 1-vCPU box a 30s join can expire with the
+    # barrier mid-flight, and using a half-started peer then fails with
+    # a confusing "peer not started"
     threads = [threading.Thread(target=p.start) for p in out]
     for t in threads:
         t.start()
     for t in threads:
-        t.join(30)
+        t.join(120)
+        assert not t.is_alive(), "peer start timed out"
     return out
 
 
