@@ -15,12 +15,16 @@ from kungfu_tpu.plan.peer import PeerID, PeerList
 from kungfu_tpu.runner.env import WorkerConfig
 
 
-_ports = iter(range(42101, 43000))
-
-
 def make_peer_pair(port0=None, port1=None):
-    port0 = port0 or next(_ports)
-    port1 = port1 or next(_ports)
+    # OS-assigned free ports, NOT a fixed range: this module can be
+    # imported under two names ("test_pair_averaging" by collection and
+    # "tests.test_pair_averaging" by cross-file imports), and a fixed
+    # per-module iterator then hands out the same ports twice -> flaky
+    # EADDRINUSE under the full suite
+    from kungfu_tpu.cmd import _reserve_ports
+
+    if port0 is None or port1 is None:
+        port0, port1 = _reserve_ports(2)
     ids = [PeerID("127.0.0.1", port0), PeerID("127.0.0.1", port1)]
     peers = PeerList(ids)
     out = []
