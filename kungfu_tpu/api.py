@@ -78,18 +78,27 @@ def all_reduce_array(
 
 
 def group_all_reduce_arrays(
-    xs, op: ReduceOp = ReduceOp.SUM, name: str = "group"
+    xs, op: ReduceOp = ReduceOp.SUM, name: str = "group", outs=None
 ):
-    """Concurrent host-plane allreduce of a list of arrays (one windowed
-    group op — the way the reference reduces a whole gradient set)."""
+    """Host-plane allreduce of a list of arrays (one fused/windowed group
+    op — the way the reference reduces a whole gradient set). Pass
+    `outs` (same shapes/dtypes as `xs`) to reuse result buffers across
+    steps — the reference's TF op outputs are graph-allocated once, and
+    fresh 100 MB of np.empty per step costs real page-fault time."""
     flats = [np.ascontiguousarray(x).reshape(-1) for x in xs]
-    outs = [np.empty_like(f) for f in flats]
+    if outs is None:
+        outs = [np.empty_like(f) for f in flats]
+        flat_outs = outs
+    else:
+        if len(outs) != len(xs):
+            raise ValueError(f"outs mismatch: {len(outs)} != {len(xs)}")
+        flat_outs = [o.reshape(-1) for o in outs]
     ws = [
         Workspace(send=f, recv=o, op=op, name=f"kungfu::user::{name}:{i}")
-        for i, (f, o) in enumerate(zip(flats, outs))
+        for i, (f, o) in enumerate(zip(flats, flat_outs))
     ]
     get_default_peer().current_session().group_all_reduce(ws)
-    return [o.reshape(x.shape) for o, x in zip(outs, xs)]
+    return [o.reshape(x.shape) for o, x in zip(flat_outs, xs)]
 
 
 def broadcast_array(x: np.ndarray, root: int = 0, name: str = "user") -> np.ndarray:

@@ -30,6 +30,17 @@ _lib.kf_transform2.argtypes = [
 ]
 
 
+_lib.kf_transform_n.restype = ctypes.c_int
+_lib.kf_transform_n.argtypes = [
+    ctypes.c_void_p,
+    ctypes.POINTER(ctypes.c_void_p),
+    ctypes.c_int32,
+    ctypes.c_int64,
+    ctypes.c_int32,
+    ctypes.c_int32,
+]
+
+
 def supported(dtype) -> bool:
     try:
         DType.from_numpy(dtype)
@@ -50,3 +61,21 @@ def transform2(dst: np.ndarray, x: np.ndarray, y: np.ndarray, op: int) -> None:
     rc = _lib.kf_transform2(pd, px, py, dst.size, int(dt), int(op))
     if rc != 0:
         raise ValueError(f"native transform2 unsupported: dtype={dt}, op={op}")
+
+
+def transform_n(dst: np.ndarray, srcs, op: int) -> None:
+    """dst = srcs[0] op srcs[1] op ... in ONE pass; dst must not alias
+    any src (native/reduce.cpp kf_transform_n)."""
+    dt = DType.from_numpy(dst.dtype)
+    pd = _ptr(dst)
+    ptrs = (ctypes.c_void_p * len(srcs))()
+    for i, s in enumerate(srcs):
+        p = _ptr(s)
+        if p is None:
+            raise ValueError("non-contiguous buffer")
+        ptrs[i] = p
+    if pd is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_transform_n(pd, ptrs, len(srcs), dst.size, int(dt), int(op))
+    if rc != 0:
+        raise ValueError(f"native transform_n unsupported: dtype={dt}, op={op}")

@@ -62,3 +62,21 @@ def transform2(dst: np.ndarray, x: np.ndarray, y: np.ndarray, op: ReduceOp) -> N
 def reduce_inplace(acc: np.ndarray, incoming: np.ndarray, op: ReduceOp) -> None:
     """acc = acc `op` incoming."""
     transform2(acc, acc, incoming, op)
+
+
+def transform_n(dst: np.ndarray, srcs, op: ReduceOp) -> None:
+    """dst = srcs[0] op srcs[1] op ... op srcs[k-1] in ONE memory pass
+    (native kernel); dst must not alias any src. The k-1 pairwise
+    equivalent re-reads and re-writes dst k-2 extra times — at a STAR
+    root this n-ary form is the difference between ~5 and ~2k passes
+    over the payload. Falls back to pairwise numpy."""
+    if len(srcs) == 1:
+        np.copyto(dst, srcs[0])
+        return
+    native = _load_native()
+    if native and native.supported(dst.dtype):
+        native.transform_n(dst, srcs, int(op))
+        return
+    _NUMPY_OPS[op](srcs[0], srcs[1], out=dst)
+    for s in srcs[2:]:
+        _NUMPY_OPS[op](dst, s, out=dst)

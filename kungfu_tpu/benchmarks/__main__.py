@@ -51,23 +51,30 @@ def bench_xla(model: str, iters: int, warmup: int = 3) -> None:
     print(f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) [XLA x{n} devices, {model}]")
 
 
-def bench_host(model: str, iters: int) -> None:
+def bench_host(model: str, iters: int, warmup: int = 2) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
 
     grads = fake_gradients(model)
+    outs = [np.empty_like(g) for g in grads]
     total_bytes = sum(g.nbytes for g in grads)
     api.run_barrier()
+    # warmup: connection + shm-arena setup and first-touch page faults
+    # belong to session bring-up, not steady-state bandwidth (the XLA
+    # bench warms up identically)
+    for i in range(warmup):
+        api.group_all_reduce_arrays(grads, name=f"warmup:{i}", outs=outs)
     samples = []
     for i in range(iters):
         t0 = time.perf_counter()
-        api.group_all_reduce_arrays(grads, name=f"bench:{i}")
+        api.group_all_reduce_arrays(grads, name=f"bench:{i}", outs=outs)
         dt = time.perf_counter() - t0
         samples.append(total_bytes / dt / (1 << 30))
     mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
     if api.current_rank() == 0:
+        med = float(np.median(samples))
         print(
-            f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) "
+            f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) median {med:.3f} "
             f"[HOST x{api.cluster_size()} workers, {model}]"
         )
 

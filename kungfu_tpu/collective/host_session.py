@@ -18,11 +18,12 @@ from __future__ import annotations
 import os
 import threading
 import time
-from typing import Callable, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence
 
 import numpy as np
 
-from kungfu_tpu.base.ops import ReduceOp, reduce_inplace
+from kungfu_tpu.base.ops import ReduceOp, reduce_inplace, transform_n
+from kungfu_tpu.utils import trace
 from kungfu_tpu.base.strategy import Strategy
 from kungfu_tpu.collective.adaptive import AdaptiveState
 from kungfu_tpu.base.workspace import Workspace, even_partition
@@ -35,11 +36,27 @@ from kungfu_tpu.transport.message import ConnType, Flags
 from kungfu_tpu.utils.pool import get_buffer_pool, get_pool
 from kungfu_tpu.utils.stall import stall_detect
 
-# 1 MiB default, parity: session.go chunkSize; tunable because the optimal
+# Chunking (parity: session.go chunkSize, but self-tuned): the optimal
 # trades chunk-walk overhead (fewer, bigger chunks) against striping/
-# pipelining (more, smaller chunks) and depends on host core count
-CHUNK_BYTES = int(os.environ.get("KF_CONFIG_CHUNK_BYTES", str(1 << 20)))
+# pipelining (more, smaller chunks) and depends on host core count —
+# concurrent chunk walks only pay when cores exist to run them; on a
+# 1-core host every extra in-flight chunk is pure context-switch cost.
+# KF_CONFIG_CHUNK_BYTES overrides the heuristic.
+CHUNK_BYTES = int(os.environ.get("KF_CONFIG_CHUNK_BYTES", "0"))
+_CHUNK_MIN = 1 << 20
+_CHUNK_MAX = 32 << 20
 DEFAULT_TIMEOUT = 120.0
+
+
+def choose_chunk_bytes(total: int) -> int:
+    """Chunk size for a `total`-byte collective: honour the env override,
+    else size chunks so ~2 walks per core are in flight, clamped to
+    [1 MiB, 32 MiB]."""
+    if CHUNK_BYTES > 0:
+        return CHUNK_BYTES
+    target_inflight = 2 * (os.cpu_count() or 1)
+    c = total // max(1, target_inflight)
+    return max(_CHUNK_MIN, min(_CHUNK_MAX, c))
 
 
 def _par(
@@ -175,17 +192,41 @@ class HostSession:
         or max(1, min(8, os.cpu_count() or 1))
     )
 
+    # Gradient bucketing: fuse same-(dtype, op) workspaces into ONE
+    # contiguous walk. A 160-tensor gradient set otherwise pays the fixed
+    # per-walk cost (rendezvous conditions, pool dispatch, ~6 framed
+    # messages) 160 times — on a host-plane reduce that overhead rivals
+    # the byte-copy time itself. Two extra memcpy passes (pack + unpack)
+    # buy a ~160x cut in message count. The reference runs one collective
+    # per tensor and leans on cheap goroutines instead; bucketing is the
+    # standard DDP/Horovod answer and is strictly better here.
+    FUSE_MIN_TENSORS = int(os.environ.get("KF_CONFIG_GROUP_FUSE_MIN", "4"))
+
     def group_all_reduce(self, ws: Sequence[Workspace]) -> None:
-        """Concurrent allreduce of many workspaces (parity: the reference
-        runs one collective per tensor through the NCCL-scheduler queue in
-        a single session.run — srcs/python/kungfu/tensorflow/v1/benchmarks).
-        Windowed so a 160-tensor gradient set doesn't explode into
-        thousands of in-flight chunk walks."""
+        """Allreduce of many workspaces as one windowed group op (parity:
+        the reference reduces a whole gradient set per session.run —
+        srcs/python/kungfu/tensorflow/v1/benchmarks)."""
         if not ws:
             return
         with stall_detect(f"group_all_reduce[{len(ws)}]"):
-            for i in range(0, len(ws), self.GROUP_WINDOW):
-                batch = ws[i : i + self.GROUP_WINDOW]
+            singles: List[Workspace] = []
+            groups: Dict[tuple, List[Workspace]] = {}
+            for w in ws:
+                if w.is_empty:
+                    continue
+                groups.setdefault((w.send.dtype.str, int(w.op)), []).append(w)
+            fused_jobs: List[Callable[[], None]] = []
+            for members in groups.values():
+                if len(members) < self.FUSE_MIN_TENSORS:
+                    singles.extend(members)
+                else:
+                    fused_jobs.append(
+                        lambda ms=members: self._fused_all_reduce(ms)
+                    )
+            for job in fused_jobs:
+                job()
+            for i in range(0, len(singles), self.GROUP_WINDOW):
+                batch = singles[i : i + self.GROUP_WINDOW]
                 _par(
                     [
                         lambda w=w: self._run_strategies(w, self.global_strategies)
@@ -193,6 +234,43 @@ class HostSession:
                     ],
                     self.timeout,
                 )
+
+    def _fused_all_reduce(self, members: List[Workspace]) -> None:
+        """Pack same-(dtype, op) workspaces into one contiguous buffer,
+        allreduce once, unpack. Workspace order is the caller's tensor
+        order, which is identical on every peer, so the fused name and
+        layout agree cluster-wide."""
+        dtype = members[0].send.dtype
+        op = members[0].op
+        total = sum(w.send.size for w in members)
+        nbytes = total * dtype.itemsize
+        pool = get_buffer_pool()
+        send_b = pool.get(nbytes)
+        recv_b = pool.get(nbytes)
+        try:
+            with trace.span("host.fuse.pack"):
+                send = np.frombuffer(send_b, dtype, total)
+                recv = np.frombuffer(recv_b, dtype, total)
+                off = 0
+                for w in members:
+                    send[off : off + w.send.size] = w.send
+                    off += w.send.size
+            fused = Workspace(
+                send=send,
+                recv=recv,
+                op=op,
+                name=f"{members[0].name}::fused{len(members)}x{total}",
+            )
+            with trace.span("host.fuse.walk"):
+                self._run_strategies(fused, self.global_strategies)
+            with trace.span("host.fuse.unpack"):
+                off = 0
+                for w in members:
+                    np.copyto(w.recv, recv[off : off + w.recv.size])
+                    off += w.recv.size
+        finally:
+            pool.put(send_b)
+            pool.put(recv_b)
 
     def monitored_all_reduce(self, w: Workspace) -> None:
         """AllReduce + throughput accounting for the ACTIVE strategy
@@ -392,12 +470,16 @@ class HostSession:
             return
         cancel = threading.Event()
         parts: List[Optional[np.ndarray]] = [None] * len(self.peers)
+        releases: List = [None] * len(self.peers)
 
         def recv_part(r: int, peer: PeerID) -> None:
             msg = self.endpoint.recv(peer, w.name, self.timeout)
             if cancel.is_set():
+                if msg.release is not None:
+                    msg.release()
                 return
             parts[r] = np.frombuffer(msg.data, w.send.dtype)
+            releases[r] = msg.release
 
         jobs = []
         for r, peer in enumerate(self.peers):
@@ -405,22 +487,28 @@ class HostSession:
                 parts[r] = w.send.reshape(-1)
             else:
                 jobs.append(lambda r=r, p=peer: recv_part(r, p))
-        _par(jobs, self.timeout, cancel)
-        off = 0
-        for part in parts:
-            assert part is not None
-            n = part.size
-            if off + n > w.recv.size:
+        try:
+            _par(jobs, self.timeout, cancel)
+            off = 0
+            for part in parts:
+                assert part is not None
+                n = part.size
+                if off + n > w.recv.size:
+                    raise ValueError(
+                        f"gather overflow: recv buffer {w.recv.size} < {off + n}"
+                    )
+                np.copyto(w.recv[off:off + n], part)
+                off += n
+            if off != w.recv.size:
+                # a short contribution would silently shift later ranks' data
                 raise ValueError(
-                    f"gather overflow: recv buffer {w.recv.size} < {off + n}"
+                    f"gather underflow: contributions fill {off} of {w.recv.size}"
                 )
-            np.copyto(w.recv[off:off + n], part)
-            off += n
-        if off != w.recv.size:
-            # a short contribution would silently shift later ranks' data
-            raise ValueError(
-                f"gather underflow: contributions fill {off} of {w.recv.size}"
-            )
+        finally:
+            parts.clear()
+            for rel in releases:
+                if rel is not None:
+                    rel()
 
     def all_gather(self, w: Workspace) -> None:
         """Gather to root then broadcast the concatenation (parity:
@@ -435,7 +523,7 @@ class HostSession:
 
     def _run_strategies(self, w: Workspace, strategies: List[st.StrategyPair]) -> None:
         total = w.recv.size * w.recv.itemsize
-        k = max(1, -(-total // CHUNK_BYTES))
+        k = max(1, -(-total // choose_chunk_bytes(total)))
         chunks = w.split(even_partition, k) if k > 1 else [w]
         cancel = threading.Event()
         if k == 1:
@@ -470,6 +558,7 @@ class HostSession:
             return
         if cancel is None:
             cancel = threading.Event()
+        _t_walk = time.perf_counter()
 
         state = {"recv_count": 0}
         lock = threading.Lock()
@@ -492,8 +581,10 @@ class HostSession:
         def recv_payload(peer: PeerID):
             """Receive (peer, w.name) into a pooled scratch buffer —
             delivered straight off the socket when we're parked first
-            (sink path), else from the buffered Message. Returns
-            (ndarray view, scratch-or-None to return to the pool)."""
+            (sink path), else from the buffered Message (possibly a
+            zero-copy shm borrow). Returns (ndarray view, scratch-or-None
+            to return to the pool, release-or-None to call once the view
+            has been consumed)."""
             scratch = bufpool.get(nbytes)
             # on error the scratch is deliberately NOT returned to the pool:
             # a timed-out sink may still be mid-fill by the transport thread
@@ -501,35 +592,89 @@ class HostSession:
                 peer, w.name, memoryview(scratch), self.timeout
             )
             if filled:
-                return np.frombuffer(scratch, w.send.dtype), scratch
+                return np.frombuffer(scratch, w.send.dtype), scratch, None
             bufpool.put(scratch)  # unused: sender raced us or size mismatch
-            return np.frombuffer(msg.data, w.send.dtype), None
+            return (
+                np.frombuffer(msg.data, w.send.dtype),
+                None,
+                msg.release,
+            )
 
         def recv_onto(peer: PeerID) -> None:
-            incoming, scratch = recv_payload(peer)
-            with lock:
-                if cancel.is_set():
-                    # abort the whole walk: a late arrival must neither write
-                    # the workspace nor let the send phase relay stale data
-                    raise TimeoutError(f"collective cancelled: {w.name}")
-                if state["recv_count"] == 0 and not w.is_inplace:
-                    # first arrival: recv = send (op) incoming
-                    from kungfu_tpu.base.ops import transform2
+            incoming, scratch, release = recv_payload(peer)
+            try:
+                with lock:
+                    if cancel.is_set():
+                        # abort the whole walk: a late arrival must neither
+                        # write the workspace nor let the send phase relay
+                        # stale data
+                        raise TimeoutError(f"collective cancelled: {w.name}")
+                    if state["recv_count"] == 0 and not w.is_inplace:
+                        # first arrival: recv = send (op) incoming
+                        from kungfu_tpu.base.ops import transform2
 
-                    transform2(w.recv, w.send, incoming, w.op)
-                else:
-                    reduce_inplace(w.recv, incoming, w.op)
-                state["recv_count"] += 1
+                        transform2(w.recv, w.send, incoming, w.op)
+                    else:
+                        reduce_inplace(w.recv, incoming, w.op)
+                    state["recv_count"] += 1
+            finally:
+                del incoming
+                if release is not None:
+                    release()
             if scratch is not None:
                 bufpool.put(scratch)
 
+        def recv_all_onto(peers: List[PeerID]) -> None:
+            """Accumulate phase: receive every prev, then reduce them all
+            in ONE n-ary pass (kf_transform_n). Pairwise-on-arrival
+            overlaps receive with reduce, which pays when cores are free;
+            the n-ary pass minimizes memory traffic, which wins outright
+            on busy/low-core hosts — and the receives themselves still
+            overlap each other."""
+            got: List = [None] * len(peers)
+
+            def grab(i: int, p: PeerID) -> None:
+                got[i] = recv_payload(p)
+
+            try:
+                _par(
+                    [lambda i=i, p=p: grab(i, p) for i, p in enumerate(peers)],
+                    self.timeout,
+                    cancel,
+                )
+                with lock:
+                    if cancel.is_set():
+                        raise TimeoutError(f"collective cancelled: {w.name}")
+                    if w.is_inplace:
+                        for incoming, _, _ in got:
+                            reduce_inplace(w.recv, incoming, w.op)
+                    else:
+                        transform_n(
+                            w.recv,
+                            [w.send] + [inc for inc, _, _ in got],
+                            w.op,
+                        )
+                    state["recv_count"] += len(peers)
+            finally:
+                for item in got:
+                    if item is not None and item[2] is not None:
+                        item[2]()
+            for item in got:
+                if item is not None and item[1] is not None:
+                    bufpool.put(item[1])
+
         def recv_into(peer: PeerID) -> None:
-            incoming, scratch = recv_payload(peer)
-            with lock:
-                if cancel.is_set():
-                    raise TimeoutError(f"collective cancelled: {w.name}")
-                np.copyto(w.recv, incoming)
-                state["recv_count"] += 1
+            incoming, scratch, release = recv_payload(peer)
+            try:
+                with lock:
+                    if cancel.is_set():
+                        raise TimeoutError(f"collective cancelled: {w.name}")
+                    np.copyto(w.recv, incoming)
+                    state["recv_count"] += 1
+            finally:
+                del incoming
+                if release is not None:
+                    release()
             if scratch is not None:
                 bufpool.put(scratch)
 
@@ -537,8 +682,11 @@ class HostSession:
             prevs = [self.peers[r] for r in g.prevs(self.rank)]
             nexts = [self.peers[r] for r in g.nexts(self.rank)]
             if g.is_self_loop(self.rank):
-                # accumulate: receive from all prevs (parallel), then send on
-                _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout, cancel)
+                # accumulate: receive from all prevs, n-ary reduce, send on
+                if prevs and state["recv_count"] == 0:
+                    recv_all_onto(prevs)
+                else:
+                    _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout, cancel)
                 _par([lambda p=p: send_to(p) for p in nexts], self.timeout, cancel)
             else:
                 # pass-through node: take value from single prev (or forward
@@ -553,3 +701,5 @@ class HostSession:
                     self.timeout,
                     cancel,
                 )
+        trace.record(f"host.walk[{w.recv.nbytes >> 20}MiB]",
+                     time.perf_counter() - _t_walk)

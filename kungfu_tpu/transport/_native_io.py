@@ -1,0 +1,97 @@
+"""ctypes loader for the native socket pump (native/io_pump.cpp).
+
+The pump runs a whole framed send (writev of header+payload) or an exact
+n-byte receive in ONE GIL-released call, replacing per-64KB Python loop
+iterations that each re-acquire the GIL under transport-thread contention
+(parity target: the reference's goroutine byte loops,
+srcs/go/rchannel/connection/connection.go:90-146).
+
+Falls back silently when the shared library hasn't been built — all
+callers must guard on `available`.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import socket
+
+_LIB_PATH = os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))),
+    "base",
+    "libkfnative.so",
+)
+
+available = False
+_lib = None
+
+try:
+    _lib = ctypes.CDLL(_LIB_PATH)
+    _lib.kf_send2.restype = ctypes.c_int
+    _lib.kf_send2.argtypes = [
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+    ]
+    _lib.kf_recv_exact.restype = ctypes.c_int
+    _lib.kf_recv_exact.argtypes = [
+        ctypes.c_int,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int,
+    ]
+    available = True
+except (OSError, AttributeError):
+    pass
+
+
+def _timeout_ms(sock: socket.socket) -> int:
+    t = sock.gettimeout()
+    return -1 if t is None else max(1, int(t * 1000))
+
+
+def _as_arg(data):
+    """(ctypes-passable buffer object, nbytes) for any contiguous buffer,
+    without copying. The returned object is passed as a foreign-call
+    argument, which keeps it (and the memory it references) alive for the
+    duration of the call."""
+    view = data if isinstance(data, memoryview) else memoryview(data)
+    n = view.nbytes
+    if n == 0:
+        return None, 0
+    if not view.readonly:
+        return (ctypes.c_char * n).from_buffer(view), n
+    # read-only: bytes expose their internal pointer via c_char_p with no
+    # copy; any other read-only exporter is copied (rare on these paths)
+    obj = view.obj if isinstance(view.obj, bytes) and view.nbytes == len(view.obj) else view.tobytes()
+    return ctypes.c_char_p(obj), n
+
+
+def _check(rc: int, what: str) -> None:
+    if rc == 0:
+        return
+    if rc == -1:
+        raise ConnectionError(f"peer closed connection during {what}")
+    if rc == -2:
+        raise socket.timeout(f"timed out during {what}")
+    raise OSError(-rc, f"{what}: {os.strerror(-rc)}")
+
+
+def send2(sock: socket.socket, head: bytes, payload, payload_nbytes: int) -> None:
+    """One writev-looped send of [head | payload], GIL released."""
+    pbuf, pn = (_as_arg(payload) if payload_nbytes else (None, 0))
+    rc = _lib.kf_send2(
+        sock.fileno(), head, len(head), pbuf, pn, _timeout_ms(sock)
+    )
+    _check(rc, "send")
+
+
+def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    """Receive exactly len(view) bytes into the writable view, GIL
+    released."""
+    buf, n = _as_arg(view)
+    rc = _lib.kf_recv_exact(sock.fileno(), buf, n, _timeout_ms(sock))
+    _check(rc, "recv")

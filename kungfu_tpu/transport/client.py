@@ -14,10 +14,13 @@ import time
 from typing import Dict, Optional, Tuple
 
 from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.transport import shm
+from kungfu_tpu.utils import trace
 from kungfu_tpu.transport.message import (
     ConnType,
     Flags,
     Message,
+    nbytes_of,
     recv_ack,
     send_header,
     send_message,
@@ -36,6 +39,10 @@ class Client:
         self._locks: Dict[Tuple[PeerID, ConnType], threading.Lock] = {}
         self._pool_lock = threading.Lock()
         self._use_unix = use_unix
+        # shared-memory arenas for colocated peers, one per live
+        # connection; (re)created whenever the connection is (re)made so
+        # ring sequence numbers reset with the epoch
+        self._arenas: Dict[Tuple[PeerID, ConnType], "shm.SenderArena"] = {}
         # egress accounting (parity: monitor.Egress called from the
         # connection send path, srcs/go/monitor/monitor.go:28-72)
         from kungfu_tpu.monitor import net as _net
@@ -54,6 +61,33 @@ class Client:
                 except OSError:
                     pass
             self._pool.clear()
+            for arena in self._arenas.values():
+                arena.close()
+            self._arenas.clear()
+
+    def _colocated(self, peer: PeerID) -> bool:
+        def is_loop(h: str) -> bool:
+            return h == "localhost" or h.startswith("127.")
+
+        return peer.host == self.self_id.host or (
+            is_loop(peer.host) and is_loop(self.self_id.host)
+        )
+
+    def _fresh_arena(self, key: Tuple[PeerID, ConnType]):
+        """(Re)create the sender arena for a freshly-made connection."""
+        old = self._arenas.pop(key, None)
+        if old is not None:
+            old.close()
+        peer, conn_type = key
+        arena = shm.SenderArena(
+            shm.arena_path(
+                peer.host, peer.port,
+                self.self_id.host, self.self_id.port,
+                int(conn_type),
+            )
+        )
+        self._arenas[key] = arena
+        return arena
 
     def _connect(self, peer: PeerID, conn_type: ConnType) -> socket.socket:
         last_err: Optional[Exception] = None
@@ -100,6 +134,29 @@ class Client:
         flags: Flags = Flags.NONE,
     ) -> None:
         key, lock, sock = self._get(peer, conn_type)
+        data_len = nbytes_of(data)
+        use_shm = (
+            data_len >= shm.SHM_MIN_BYTES
+            and conn_type
+            in (ConnType.COLLECTIVE, ConnType.PEER_TO_PEER, ConnType.QUEUE)
+            and shm.enabled()
+            and self._colocated(peer)
+        )
+
+        def wire_message() -> Message:
+            """Build the on-socket frame; for shm sends this memcpys the
+            payload into the ring and frames only the descriptor. A full
+            ring falls back to the socket frame (kernel flow control)."""
+            if not use_shm:
+                return Message(name=name, data=data, flags=flags)
+            arena = self._arenas.get(key)
+            if arena is None:
+                arena = self._fresh_arena(key)
+            desc = arena.try_write(data, data_len)
+            if desc is None:
+                return Message(name=name, data=data, flags=flags)
+            return Message(name=name, data=desc, flags=flags | Flags.SHM_REF)
+
         with lock:
             with self._pool_lock:
                 sock = self._pool.get(key)
@@ -107,10 +164,14 @@ class Client:
                 sock = self._connect(peer, conn_type)
                 with self._pool_lock:
                     self._pool[key] = sock
+                if use_shm:
+                    self._fresh_arena(key)
+            _t0 = time.perf_counter()
             try:
-                send_message(sock, Message(name=name, data=data, flags=flags))
+                send_message(sock, wire_message())
             except (ConnectionError, OSError):
-                # one reconnect attempt, then fail up
+                # one reconnect attempt, then fail up; the arena is
+                # re-created so the descriptor targets the fresh ring
                 try:
                     sock.close()
                 except OSError:
@@ -118,9 +179,12 @@ class Client:
                 sock = self._connect(peer, conn_type)
                 with self._pool_lock:
                     self._pool[key] = sock
-                send_message(sock, Message(name=name, data=data, flags=flags))
+                if use_shm:
+                    self._fresh_arena(key)
+                send_message(sock, wire_message())
+            trace.record("transport.send", time.perf_counter() - _t0)
         if self._monitor is not None:
-            self._monitor.sent(peer, len(data))
+            self._monitor.sent(peer, data_len)
 
     def ping(self, peer: PeerID, timeout: float = 2.0) -> bool:
         try:

@@ -20,6 +20,14 @@ from typing import Tuple
 
 MAGIC = 0x4B465450  # "KFTP"
 
+try:
+    from kungfu_tpu.transport import _native_io as _nio
+
+    _NATIVE = _nio.available
+except ImportError:  # pragma: no cover - loader guards its own failures
+    _nio = None
+    _NATIVE = False
+
 
 class ConnType(enum.IntEnum):
     PING = 0
@@ -34,6 +42,7 @@ class Flags(enum.IntFlag):
     WAIT_RECV_BUF = 1  # receiver must deliver into a registered buffer
     IS_RESPONSE = 2
     REQUEST_FAILED = 4
+    SHM_REF = 8  # payload is a {offset,len,advance} shm-arena descriptor
 
 
 @dataclasses.dataclass
@@ -41,6 +50,9 @@ class Message:
     name: str
     data: "bytes | bytearray | memoryview"  # any buffer; np.frombuffer-able
     flags: Flags = Flags.NONE
+    # borrow protocol: set when `data` is a mapped shm region owned by the
+    # ring — the consumer MUST call it exactly once when done with `data`
+    release: "object" = None
 
 
 # ---------------------------------------------------------------------------
@@ -52,6 +64,10 @@ _FRAME = struct.Struct("<III")  # name_len, flags, data_len
 
 
 def _recv_exact_into(sock: socket.socket, view: memoryview) -> None:
+    if _NATIVE:
+        # whole receive in one GIL-released call (native/io_pump.cpp)
+        _nio.recv_exact_into(sock, view)
+        return
     n = len(view)
     got = 0
     while got < n:
@@ -97,8 +113,13 @@ def recv_ack(sock: socket.socket) -> int:
 def send_message(sock: socket.socket, msg: Message) -> None:
     name_b = msg.name.encode()
     data_len = nbytes_of(msg.data)
+    head = _FRAME.pack(len(name_b), int(msg.flags), data_len) + name_b
+    if _NATIVE:
+        # header+payload in one GIL-released writev loop (io_pump.cpp)
+        _nio.send2(sock, head, msg.data, data_len)
+        return
     # one syscall for frame+name; payload separate (never copy it)
-    sock.sendall(_FRAME.pack(len(name_b), int(msg.flags), data_len) + name_b)
+    sock.sendall(head)
     if data_len:
         sock.sendall(msg.data)
 

@@ -14,11 +14,17 @@ import os
 import socket
 import struct
 import threading
+import time as _time
+
+import numpy as np
 from typing import Callable, Dict, Optional
 
 from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.transport import shm
+from kungfu_tpu.utils import trace
 from kungfu_tpu.transport.message import (
     ConnType,
+    Flags,
     Message,
     _recv_exact,
     _recv_exact_into,
@@ -139,6 +145,9 @@ class Server:
             ).start()
 
     def _serve_conn(self, conn: socket.socket) -> None:
+        # shared-memory receive state (lazy: first SHM_REF frame maps the
+        # sender's arena; per-connection so epochs reset cleanly)
+        rx_state: Dict[str, object] = {}
         try:
             conn_type, src_host, src_port, token = recv_header(conn)
             # Token check: PING and CONTROL are version-independent (they
@@ -160,6 +169,20 @@ class Server:
             from kungfu_tpu.monitor import net as _net
 
             monitor = _net.get_monitor() if _net.enabled() else None
+
+            def shm_region(desc: bytes):
+                """Resolve a descriptor frame to (view, release)."""
+                off, length, advance = shm.DESC.unpack(bytes(desc))
+                arena = rx_state.get("arena")
+                if arena is None:
+                    arena = shm.ReceiverArena(
+                        shm.arena_path(
+                            self.self_id.host, self.self_id.port,
+                            src.host, src.port, int(conn_type),
+                        )
+                    )
+                    rx_state["arena"] = arena
+                return arena.region(off, length, advance)
             # Zero-copy receive: when the registered endpoint exposes the
             # sink protocol (CollectiveEndpoint), read the frame header
             # first and, if a receiver is already parked on (src, name)
@@ -171,21 +194,61 @@ class Server:
             if take_sink is None:
                 while not self._stopped.is_set():
                     msg = recv_message(conn)
+                    nbytes = len(msg.data)
+                    if msg.flags & Flags.SHM_REF:
+                        # CONTROL/QUEUE/P2P endpoints buffer messages for
+                        # arbitrarily long — copy out of the ring and
+                        # release immediately (GIL-free numpy memcpy)
+                        view, release = shm_region(msg.data)
+                        nbytes = len(view)
+                        buf = bytearray(nbytes)
+                        np.copyto(
+                            np.frombuffer(buf, np.uint8),
+                            np.frombuffer(view, np.uint8),
+                        )
+                        release()
+                        msg = Message(
+                            name=msg.name,
+                            data=buf,
+                            flags=msg.flags & ~Flags.SHM_REF,
+                        )
                     if monitor is not None:
-                        monitor.received(src, len(msg.data))
+                        monitor.received(src, nbytes)
                     handler(src, msg)
             else:
                 finish_sink = endpoint.finish_sink
                 while not self._stopped.is_set():
                     name, flags, data_len = recv_frame_header(conn)
+                    if flags & Flags.SHM_REF:
+                        desc = _recv_exact(conn, data_len)
+                        view, release = shm_region(desc)
+                        data_len = len(view)
+                        flags &= ~Flags.SHM_REF
+                        # always borrow — even when a sink is parked, the
+                        # walk reduces straight from the mapped ring, so a
+                        # transport-thread copy here would be pure waste
+                        handler(
+                            src,
+                            Message(
+                                name=name, data=view, flags=flags,
+                                release=release,
+                            ),
+                        )
+                        if monitor is not None:
+                            monitor.received(src, data_len)
+                        continue
                     sink = take_sink(src, name, data_len) if data_len else None
                     if sink is not None:
+                        _t0 = _time.perf_counter()
                         try:
                             _recv_exact_into(conn, sink.view)
                         except BaseException:
                             finish_sink(src, name, sink, flags, ok=False)
                             raise
                         finish_sink(src, name, sink, flags, ok=True)
+                        trace.record(
+                            "transport.recv_sink", _time.perf_counter() - _t0
+                        )
                     else:
                         data = _recv_exact(conn, data_len) if data_len else b""
                         handler(src, Message(name=name, data=data, flags=flags))
@@ -198,6 +261,9 @@ class Server:
             # struct): a garbage-sending peer must not take the server down
             pass
         finally:
+            arena = rx_state.get("arena")
+            if arena is not None:
+                arena.close()
             try:
                 conn.close()
             except OSError:

@@ -1,0 +1,187 @@
+"""Shared-memory data plane for colocated peers.
+
+Capability parity note: the reference's rchannel moves every payload
+through TCP/Unix sockets (srcs/go/rchannel/connection/connection.go) —
+fine when each peer owns a core, but a kfrun localhost cluster is N
+processes sharing a box, and every socket byte costs two kernel copies
+plus backpressure coupling: a 29 MiB send blocks the SENDER until the
+busy receiver drains a ~208 KiB pipe. Here large payloads ride a
+per-(sender->receiver, conn_type) shared-memory ring: the sender memcpys
+into the arena and completes immediately; the tiny descriptor frame
+{offset, length, advance} travels over the existing framed socket (so
+ordering, epochs, and demux are unchanged); the receiver either memcpys
+out (sink path) or hands the mapped region zero-copy to the collective
+walk (borrow path) and releases it after the reduce.
+
+Ring protocol (SPSC by construction: client.send holds the per-connection
+lock; one transport thread serves each connection):
+  header page: magic u64 | capacity u64 | alloc_seq u64 | consumed_seq u64
+  alloc_seq   monotonically counts bytes allocated (incl. wrap padding);
+              written only by the sender.
+  consumed_seq counts bytes released; written only by the receiver.
+  A region never wraps: if the tail can't fit it, the sender pads to the
+  boundary and the descriptor's `advance` covers pad + length.
+Releases can complete out of order (the n-ary reduce borrows several
+regions at once), so the receiver tracks released intervals and advances
+consumed_seq only over a contiguous prefix.
+"""
+
+from __future__ import annotations
+
+import mmap
+import os
+import struct
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+import numpy as np
+
+MAGIC = 0x4B46534D454D31  # "KFSMEM1"
+HEADER = 4096
+_HDR = struct.Struct("<QQQQ")  # magic, capacity, alloc_seq, consumed_seq
+
+DEFAULT_CAPACITY = int(
+    os.environ.get("KF_CONFIG_SHM_CAPACITY", str(256 << 20))
+)
+# payloads below this stay on the socket (descriptor overhead + mmap
+# bookkeeping beat the copy savings for small frames)
+SHM_MIN_BYTES = int(os.environ.get("KF_CONFIG_SHM_MIN_BYTES", str(256 << 10)))
+
+DESC = struct.Struct("<QQQ")  # offset, length, advance
+
+
+def enabled() -> bool:
+    return os.environ.get("KF_CONFIG_SHM", "1") != "0" and os.path.isdir(
+        "/dev/shm"
+    )
+
+
+def arena_path(
+    recv_host: str, recv_port: int, send_host: str, send_port: int, conn_type: int
+) -> str:
+    return (
+        f"/dev/shm/kfshm-{recv_host}-{recv_port}" f"-{send_host}-{send_port}-{conn_type}"
+    )
+
+
+class SenderArena:
+    """Sender side: creates/resets the file, allocates regions, memcpys
+    payloads in. One instance per (peer connection); serialized by the
+    client's per-connection send lock."""
+
+    def __init__(self, path: str, capacity: int = DEFAULT_CAPACITY):
+        self.path = path
+        self.capacity = capacity
+        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        try:
+            os.ftruncate(fd, HEADER + capacity)
+            self._mm = mmap.mmap(fd, HEADER + capacity)
+        finally:
+            os.close(fd)
+        self._seq = np.frombuffer(self._mm, np.uint64, 2, offset=16)
+        # reset for a fresh epoch: receiver maps lazily after connect, so
+        # nobody holds live borrows here
+        self._mm[0:16] = struct.pack("<QQ", MAGIC, capacity)
+        self._seq[0] = 0
+        self._seq[1] = 0
+        self._data = memoryview(self._mm)[HEADER:]
+        self._alloc = 0  # mirrors _seq[0]; plain int avoids u64 churn
+
+    def try_write(self, payload, nbytes: int) -> Optional[bytes]:
+        """Copy `payload` into the ring; returns the packed descriptor, or
+        None when the ring lacks space RIGHT NOW. Never blocks: spinning
+        for ring space on a shared core starves the consumer that would
+        free it — a full ring means the receiver is behind, and the socket
+        path's kernel flow control is the right way to wait for it."""
+        cap = self.capacity
+        if nbytes > cap:
+            return None
+        off = self._alloc % cap
+        pad = cap - off if off + nbytes > cap else 0
+        advance = pad + nbytes
+        if self._alloc + advance - int(self._seq[1]) > cap:
+            return None
+        start = 0 if pad else off
+        dst = np.frombuffer(self._data, np.uint8, nbytes, offset=start)
+        src = np.frombuffer(payload, np.uint8, nbytes)
+        np.copyto(dst, src)  # releases the GIL for large copies
+        self._alloc += advance
+        self._seq[0] = self._alloc
+        return DESC.pack(start, nbytes, advance)
+
+    def close(self) -> None:
+        try:
+            self._seq = None
+            self._data.release()
+            self._mm.close()
+        except (BufferError, ValueError, OSError):
+            pass
+        try:
+            os.unlink(self.path)
+        except OSError:
+            pass
+
+
+class _OrderedReleaser:
+    """Advance consumed_seq over the contiguous prefix of released
+    [start, start+advance) intervals (borrows finish out of order)."""
+
+    def __init__(self, seq: np.ndarray):
+        self._seq = seq  # consumed_seq lives at index 1
+        self._lock = threading.Lock()
+        self._next = 0  # next expected start_seq to retire
+        self._pending: Dict[int, int] = {}  # start_seq -> advance
+
+    def release(self, start_seq: int, advance: int) -> None:
+        with self._lock:
+            self._pending[start_seq] = advance
+            while self._next in self._pending:
+                adv = self._pending.pop(self._next)
+                self._next += adv
+            self._seq[1] = self._next
+
+
+class ReceiverArena:
+    """Receiver side: maps the sender's file, exposes regions, retires
+    them in allocation order."""
+
+    def __init__(self, path: str):
+        fd = os.open(path, os.O_RDWR)
+        try:
+            size = os.fstat(fd).st_size
+            self._mm = mmap.mmap(fd, size)
+        finally:
+            os.close(fd)
+        magic, cap = struct.unpack("<QQ", self._mm[0:16])
+        if magic != MAGIC or HEADER + cap != size:
+            raise ValueError(f"bad shm arena: {path}")
+        self.capacity = cap
+        self._seq = np.frombuffer(self._mm, np.uint64, 2, offset=16)
+        self._data = memoryview(self._mm)
+        self._releaser = _OrderedReleaser(self._seq)
+        self._recv_seq = 0  # bytes of (pad+len) seen, in frame order
+
+    def region(self, offset: int, length: int, advance: int):
+        """(memoryview of the payload, release() callable). Frames arrive
+        in allocation order on the single connection, so _recv_seq
+        reconstructs each region's start_seq."""
+        start_seq = self._recv_seq  # pad (if any) leads the interval
+        self._recv_seq += advance
+        view = self._data[HEADER + offset : HEADER + offset + length]
+        rel = self._releaser
+
+        def release(_done=[False]) -> None:
+            if not _done[0]:
+                _done[0] = True
+                rel.release(start_seq, advance)
+
+        return view, release
+
+    def close(self) -> None:
+        try:
+            self._seq = None
+            self._data.release()
+            self._mm.close()
+        except (BufferError, ValueError, OSError):
+            pass

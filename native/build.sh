@@ -5,7 +5,7 @@ set -e
 cd "$(dirname "$0")"
 CXX=${1:-g++}
 OUT=../kungfu_tpu/base/libkfnative.so
-$CXX -O3 -march=native -shared -fPIC -std=c++17 -o "$OUT" reduce.cpp mst.cpp
+$CXX -O3 -march=native -shared -fPIC -std=c++17 -o "$OUT" reduce.cpp mst.cpp io_pump.cpp
 echo "built $OUT"
 # exec shim arming PR_SET_PDEATHSIG for spawned workers (Linux only)
 if [ "$(uname -s)" = "Linux" ]; then
