@@ -92,6 +92,12 @@ def group_all_reduce_arrays(
     else:
         if len(outs) != len(xs):
             raise ValueError(f"outs mismatch: {len(outs)} != {len(xs)}")
+        for o in outs:
+            # reshape(-1) of a non-contiguous array is a COPY — the
+            # collective would fill the copy and the caller's buffer
+            # would silently keep last step's data
+            if not o.flags["C_CONTIGUOUS"]:
+                raise ValueError("outs arrays must be C-contiguous")
         flat_outs = [o.reshape(-1) for o in outs]
     ws = [
         Workspace(send=f, recv=o, op=op, name=f"kungfu::user::{name}:{i}")
@@ -162,6 +168,16 @@ def last_resize_phases() -> dict:
     """Per-phase ms breakdown of the most recent resize seen by this peer
     (wait_config / consensus / notify / update)."""
     return dict(get_default_peer().last_resize_phases)
+
+
+def trace_summary(prefix: str = "") -> dict:
+    """Total ms per hot-path span recorded in this process (transport
+    send/recv, collective walks, fuse pack/unpack, elastic state sync) —
+    parity: the reference compiles TRACE_SCOPE into its GPU hot paths
+    (srcs/cpp/include/kungfu/utils/trace.hpp, gpu_collective.cpp)."""
+    from kungfu_tpu.utils import trace
+
+    return trace.summary_ms(prefix)
 
 
 def change_cluster(progress: int):

@@ -30,15 +30,22 @@ _lib.kf_transform2.argtypes = [
 ]
 
 
-_lib.kf_transform_n.restype = ctypes.c_int
-_lib.kf_transform_n.argtypes = [
-    ctypes.c_void_p,
-    ctypes.POINTER(ctypes.c_void_p),
-    ctypes.c_int32,
-    ctypes.c_int64,
-    ctypes.c_int32,
-    ctypes.c_int32,
-]
+# Guarded: a libkfnative.so built before this symbol existed must not
+# take down transform2 with it (ops._load_native treats any import-time
+# error as "no native kernels at all")
+try:
+    _lib.kf_transform_n.restype = ctypes.c_int
+    _lib.kf_transform_n.argtypes = [
+        ctypes.c_void_p,
+        ctypes.POINTER(ctypes.c_void_p),
+        ctypes.c_int32,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    has_transform_n = True
+except AttributeError:
+    has_transform_n = False
 
 
 def supported(dtype) -> bool:

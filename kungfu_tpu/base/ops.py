@@ -74,7 +74,11 @@ def transform_n(dst: np.ndarray, srcs, op: ReduceOp) -> None:
         np.copyto(dst, srcs[0])
         return
     native = _load_native()
-    if native and native.supported(dst.dtype):
+    if (
+        native
+        and getattr(native, "has_transform_n", False)
+        and native.supported(dst.dtype)
+    ):
         native.transform_n(dst, srcs, int(op))
         return
     _NUMPY_OPS[op](srcs[0], srcs[1], out=dst)

@@ -77,6 +77,11 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
             f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) median {med:.3f} "
             f"[HOST x{api.cluster_size()} workers, {model}]"
         )
+        # where the time went (hot-path spans, this process only)
+        summary = api.trace_summary()
+        top = sorted(summary.items(), key=lambda kv: -kv[1])[:6]
+        for name, ms in top:
+            print(f"TRACE {name}: {ms:.0f} ms")
 
 
 def bench_p2p(model: str, iters: int) -> None:

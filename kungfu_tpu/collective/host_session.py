@@ -50,12 +50,17 @@ DEFAULT_TIMEOUT = 120.0
 
 def choose_chunk_bytes(total: int) -> int:
     """Chunk size for a `total`-byte collective: honour the env override,
-    else size chunks so ~2 walks per core are in flight, clamped to
-    [1 MiB, 32 MiB]."""
+    else ~8 chunks per collective, clamped to [1 MiB, 32 MiB].
+
+    MUST depend only on cluster-agreed inputs (the workspace size): chunk
+    workspaces are named '<name>[i/k]', so peers that computed different
+    k would wait forever on each other's chunk names. That rules out
+    os.cpu_count() here (heterogeneous hosts); measured on the 1-core
+    box, 8 in-flight walks of >=1 MiB is within noise of the per-core
+    optimum anyway."""
     if CHUNK_BYTES > 0:
         return CHUNK_BYTES
-    target_inflight = 2 * (os.cpu_count() or 1)
-    c = total // max(1, target_inflight)
+    c = total // 8
     return max(_CHUNK_MIN, min(_CHUNK_MAX, c))
 
 
@@ -634,7 +639,14 @@ class HostSession:
             got: List = [None] * len(peers)
 
             def grab(i: int, p: PeerID) -> None:
-                got[i] = recv_payload(p)
+                res = recv_payload(p)
+                if cancel.is_set():
+                    # the walk already timed out and its finally block may
+                    # have run: release the borrow here or nobody will
+                    if res[2] is not None:
+                        res[2]()
+                    return
+                got[i] = res
 
             try:
                 _par(

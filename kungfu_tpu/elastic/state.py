@@ -56,6 +56,12 @@ class ElasticState:
     def _sync_state(self) -> None:
         if self._get_state is None:
             return
+        from kungfu_tpu.utils import trace
+
+        with trace.span("elastic.sync_state"):
+            self._sync_state_traced()
+
+    def _sync_state_traced(self) -> None:
         import jax
 
         from kungfu_tpu.base.ops import ReduceOp

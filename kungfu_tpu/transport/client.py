@@ -135,13 +135,13 @@ class Client:
     ) -> None:
         key, lock, sock = self._get(peer, conn_type)
         data_len = nbytes_of(data)
-        use_shm = (
-            data_len >= shm.SHM_MIN_BYTES
-            and conn_type
+        shm_conn = (
+            conn_type
             in (ConnType.COLLECTIVE, ConnType.PEER_TO_PEER, ConnType.QUEUE)
             and shm.enabled()
             and self._colocated(peer)
         )
+        use_shm = shm_conn and data_len >= shm.SHM_MIN_BYTES
 
         def wire_message() -> Message:
             """Build the on-socket frame; for shm sends this memcpys the
@@ -164,14 +164,17 @@ class Client:
                 sock = self._connect(peer, conn_type)
                 with self._pool_lock:
                     self._pool[key] = sock
-                if use_shm:
+                if shm_conn:
                     self._fresh_arena(key)
             _t0 = time.perf_counter()
             try:
                 send_message(sock, wire_message())
             except (ConnectionError, OSError):
                 # one reconnect attempt, then fail up; the arena is
-                # re-created so the descriptor targets the fresh ring
+                # re-created on EVERY reconnect of a shm-capable conn (not
+                # just when this send is large): the new _serve_conn's
+                # receiver starts at seq 0, and a stale sender seq would
+                # see phantom in-use bytes forever
                 try:
                     sock.close()
                 except OSError:
@@ -179,7 +182,7 @@ class Client:
                 sock = self._connect(peer, conn_type)
                 with self._pool_lock:
                     self._pool[key] = sock
-                if use_shm:
+                if shm_conn:
                     self._fresh_arena(key)
                 send_message(sock, wire_message())
             trace.record("transport.send", time.perf_counter() - _t0)

