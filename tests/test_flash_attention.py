@@ -113,3 +113,40 @@ def test_chunked_reference_matches_dense():
             _dense_reference(q, k, v, causal, sm) ** 2))(q)
         np.testing.assert_allclose(np.asarray(ga), np.asarray(gb),
                                    rtol=1e-4, atol=1e-5)
+
+
+def test_flash_gradients_non_causal_multiblock():
+    q, k, v = _qkv(B=1, H=2, S=64, hd=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, False, None, 16, 32, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            _dense_reference(q, k, v, False, 1.0 / np.sqrt(8)) ** 2
+        )
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
+
+
+def test_flash_gradients_uneven_fallback():
+    # S=24 not divisible by blk 16 -> dense fwd + remat-chunked vjp path
+    q, k, v = _qkv(B=1, H=1, S=24, hd=8)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(flash_attention(q, k, v, True, None, 16, 16, True) ** 2)
+
+    def loss_dense(q, k, v):
+        return jnp.sum(
+            _dense_reference(q, k, v, True, 1.0 / np.sqrt(8)) ** 2
+        )
+
+    gf = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    gd = jax.grad(loss_dense, argnums=(0, 1, 2))(q, k, v)
+    for a, b in zip(gf, gd):
+        np.testing.assert_allclose(np.asarray(a), np.asarray(b),
+                                   rtol=1e-4, atol=1e-5)
