@@ -27,13 +27,29 @@ import tempfile
 REPO = os.path.dirname(os.path.abspath(__file__))
 
 AGENT = r'''
-import sys, time
+import os, sys, time
+_t_start = time.monotonic()
+_t_act = float(os.environ.get("KF_ACTIVATED_TS", 0) or 0)
 import numpy as np
 from kungfu_tpu import api
 from kungfu_tpu.elastic.state import ElasticState
+_t_imports = time.monotonic()
+if _t_act:
+    print(f"JOINER wakeup={((_t_start-_t_act)*1e3):.1f} ms"
+          f" imports={((_t_imports-_t_start)*1e3):.1f} ms", flush=True)
 
 SIZES = [2, 3, 4, 2, 3, 4, 2]
 es = ElasticState(max_progress=len(SIZES) * 10)
+_su = api.trace_summary()
+if _su.get("worker.startup"):
+    print(
+        f"JOINSTART {_su['worker.startup']:.1f} ms"
+        f" parse={_su.get('worker.parse_config', 0):.1f}"
+        f" init={_su.get('worker.peer_init', 0):.1f}"
+        f" server={_su.get('worker.start.server', 0):.1f}"
+        f" update={_su.get('worker.start.update', 0):.1f}",
+        flush=True,
+    )
 t_resize = None
 while not es.stopped():
     with es.scope():

@@ -1,8 +1,18 @@
-from kungfu_tpu.monitor.noise_scale import (
-    GNSState,
-    gns_init,
-    gns_update,
-    monitor_gradient_noise_scale,
-)
+"""Monitors: gradient noise scale (device plane) + network rates (host).
+
+Lazy re-exports (PEP 562): `noise_scale` drags in jax.numpy machinery
+(~330 ms even with jax itself already imported), and the TRANSPORT
+imports this package for `monitor.net` on every Peer construction — an
+eager import here put a third of a second inside every elastic joiner's
+critical path (measured round 5, bench_resize).
+"""
 
 __all__ = ["GNSState", "gns_init", "gns_update", "monitor_gradient_noise_scale"]
+
+
+def __getattr__(name):
+    if name in __all__:
+        from kungfu_tpu.monitor import noise_scale
+
+        return getattr(noise_scale, name)
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

@@ -47,7 +47,10 @@ def get_default_peer() -> "Peer":
     global _default_peer
     with _default_lock:
         if _default_peer is None:
-            _default_peer = Peer(kfenv.parse_config_from_env())
+            with trace.span("worker.parse_config"):
+                cfg = kfenv.parse_config_from_env()
+            with trace.span("worker.peer_init"):
+                _default_peer = Peer(cfg)
             _default_peer.start()
         return _default_peer
 
@@ -106,9 +109,11 @@ class Peer:
             except ValueError:
                 pass
         if not self.config.single_process:
-            self.server.start()
+            with trace.span("worker.start.server"):
+                self.server.start()
         self._start_metrics_server()
-        self._update_to(self._peers)
+        with trace.span("worker.start.update"):
+            self._update_to(self._peers)
 
     def _start_metrics_server(self) -> None:
         """Expose /metrics on self.port+10000 when monitoring is on

@@ -59,9 +59,11 @@ def build_parser() -> argparse.ArgumentParser:
                    help="standby workers kept warm per runner in -w mode "
                         "(0 disables); activation replaces cold joiner "
                         "spawn+import during an elastic grow")
-    p.add_argument("-standby-preload", default="",
-                   help="extra comma-separated modules standbys pre-import "
-                        "(e.g. jax for device-plane agents)")
+    p.add_argument("-standby-preload", default="auto",
+                   help="comma-separated modules standbys pre-import; "
+                        "'auto' (default) pre-imports the device stack "
+                        "(jax) since this framework's agents are jax-"
+                        "based; 'none' disables")
     p.add_argument("-use-affinity", action="store_true",
                    help="pin each local worker to a disjoint, NUMA-aligned "
                         "CPU slice (parity: KUNGFU_USE_AFFINITY)")

@@ -100,6 +100,10 @@ class StandbySlot:
                     return False
                 time.sleep(0.05)
         try:
+            env = dict(env)
+            # activation instant (CLOCK_MONOTONIC is machine-wide, so the
+            # activated process can compute its own wakeup latency)
+            env["KF_ACTIVATED_TS"] = str(time.monotonic())
             spec = json.dumps({"env": env, "argv": list(argv)}) + "\n"
             os.write(fd, spec.encode())
         except OSError:
@@ -118,6 +122,17 @@ class StandbySlot:
             os.unlink(self.fifo)
         except OSError:
             pass
+
+
+def resolve_preload(spec: str) -> str:
+    """Map the -standby-preload spellings to a concrete module list:
+    'auto' -> the device stack (jax — this framework's agents are
+    jax-based; import only, no backend init), 'none'/'' -> nothing."""
+    if spec == "auto":
+        return "jax"
+    if spec == "none":
+        return ""
+    return spec
 
 
 class StandbyPool:
@@ -221,7 +236,11 @@ def main() -> None:
     import numpy  # noqa: F401
 
     import kungfu_tpu.api  # noqa: F401
+    import kungfu_tpu.monitor.net  # noqa: F401  (Peer.__init__ pulls it)
 
+    # "auto"/"none" are resolved by the POOL (resolve_preload); an unset
+    # or empty env means no extra preloads — "" must stay a working
+    # disable spelling for direct StandbyPool users
     for mod in filter(None, os.environ.get("KF_STANDBY_PRELOAD", "").split(",")):
         try:
             __import__(mod)

@@ -115,13 +115,13 @@ class Watcher:
         self.standby_pool = None
         n_spares = getattr(args, "warm_spares", 0)
         if n_spares > 0:
-            from kungfu_tpu.runner.standby import StandbyPool
+            from kungfu_tpu.runner.standby import StandbyPool, resolve_preload
 
             self.standby_pool = StandbyPool(
                 n_spares,
                 logdir=getattr(args, "logdir", ""),
                 quiet=getattr(args, "quiet", False),
-                preload=getattr(args, "standby_preload", ""),
+                preload=resolve_preload(getattr(args, "standby_preload", "")),
             )
             self.standby_pool.refill()
         self._initial_done = False
@@ -206,6 +206,7 @@ class Watcher:
     def _spawn(self, w: PeerID, stage: Stage) -> None:
         from kungfu_tpu.runner.cli import make_one_worker_proc
 
+        _t_spawn0 = time.monotonic()
         slots = None
         if self.slot_pool is not None:
             try:
@@ -237,8 +238,11 @@ class Watcher:
             self._refill_at = time.monotonic() + self.REFILL_DELAY
             slot = self.standby_pool.take()
             if slot is not None:
+                _t_act0 = time.monotonic()
                 if slot.activate(p.env, p.argv, p.name, p.rank):
-                    print(f"kfrun: warm standby activated as {p.name}",
+                    print(f"kfrun: warm standby activated as {p.name}"
+                          f" (prep {(_t_act0 - _t_spawn0) * 1e3:.1f} ms,"
+                          f" activate {(time.monotonic() - _t_act0) * 1e3:.1f} ms)",
                           file=sys.stderr)
                     with self._state_lock:
                         self.current[w] = slot.proc

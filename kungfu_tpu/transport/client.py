@@ -28,7 +28,22 @@ from kungfu_tpu.transport.message import (
 from kungfu_tpu.transport.server import unix_sock_path
 
 CONN_RETRY_COUNT = 120
+# Exponential backoff between dial attempts: an elastic joiner's server
+# comes up in tens of ms once warm, so survivors re-dialing it must not
+# quantize the whole rebuild barrier to coarse sleep ticks (a flat 250 ms
+# put a 250/500 ms floor under every resize). Start fine, cap at
+# CONN_RETRY_PERIOD so a genuinely absent peer costs the same as before
+# (tests patch PERIOD/COUNT to bound absent-peer waits; read at call time).
 CONN_RETRY_PERIOD = 0.25
+CONN_RETRY_MIN = 0.01
+CONN_RETRY_GROWTH = 1.6
+
+
+def _retry_delays():
+    d = CONN_RETRY_MIN
+    for _ in range(CONN_RETRY_COUNT):
+        yield min(d, CONN_RETRY_PERIOD)
+        d = min(d * CONN_RETRY_GROWTH, CONN_RETRY_PERIOD)
 
 
 class Client:
@@ -91,7 +106,7 @@ class Client:
 
     def _connect(self, peer: PeerID, conn_type: ConnType) -> socket.socket:
         last_err: Optional[Exception] = None
-        for _ in range(CONN_RETRY_COUNT):
+        for delay in _retry_delays():
             try:
                 if self._use_unix and peer.host in ("127.0.0.1", "localhost", self.self_id.host):
                     try:
@@ -115,7 +130,7 @@ class Client:
                 return sock
             except (ConnectionError, OSError) as e:
                 last_err = e
-                time.sleep(CONN_RETRY_PERIOD)
+                time.sleep(delay)
         raise ConnectionError(f"cannot connect to {peer} ({conn_type.name}): {last_err}")
 
     def _get(self, peer: PeerID, conn_type: ConnType):
@@ -203,10 +218,12 @@ class Client:
         """Block until peer's server answers pings (parity: router.Wait with
         WaitRunnerTimeout, peer/peer.go:200-209)."""
         deadline = time.monotonic() + timeout
+        delay = CONN_RETRY_MIN
         while time.monotonic() < deadline:
             if self.ping(peer):
                 return True
-            time.sleep(0.2)
+            time.sleep(delay)
+            delay = min(delay * CONN_RETRY_GROWTH, CONN_RETRY_PERIOD)
         return False
 
     def close(self) -> None:
