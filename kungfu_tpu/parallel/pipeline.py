@@ -17,6 +17,19 @@ Bubble fraction is (P-1)/(M+P-1); pick n_micro >= ~4x the stage count.
 Each stage also computes the (cheap) LM head every tick — dead compute on
 non-final stages that XLA cannot skip under SPMD; acceptable because the
 head is O(D*V) vs the stages' O(L/P * D^2 * S) blocks.
+
+Negative results (round 5, measured at pp=4 on the 8-device CPU mesh,
+vocab-heavy config where the dead head compute is LARGEST): gating the
+per-tick head (and the stage-0 embed gather) behind `lax.cond` so only
+the owning stage executes it ran 2x SLOWER end-to-end — AD through a
+conditional inside the tick scan costs far more than the skipped flops;
+hoisting the head out of the scan over stacked per-tick outputs (one
+large matmul, single mask site) was 13% slower (extra stacked-activation
+traffic, and the off-stage copies remain dead under where()). The
+where()-masked schedule stands as the measured-fastest formulation; a
+hand-scheduled 1F1B (manual backward interleave) is the remaining
+approach and is out of scope while its main win (activation memory)
+is already bounded by the scan's per-tick residuals.
 """
 
 from __future__ import annotations
