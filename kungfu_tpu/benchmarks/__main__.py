@@ -112,9 +112,73 @@ def bench_p2p(model: str, iters: int) -> None:
         )
 
 
+def bench_gns(iters: int) -> None:
+    """GNS monitoring overhead: train-step time with the plain S-SGD
+    optimizer vs monitor_gradient_noise_scale wrapping the same base.
+
+    Parity: the reference ships the harness but publishes no number
+    (benchmarks/monitoring/benchmark.py, BASELINE.md row 'GNS monitoring
+    overhead'). Runs a small MLP over the local device mesh."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax import lax
+
+    from kungfu_tpu.models.mlp import init_mlp, mlp_loss
+    from kungfu_tpu.monitor import monitor_gradient_noise_scale
+    from kungfu_tpu.optimizers import synchronous_sgd
+    from kungfu_tpu.parallel import DeviceSession, make_mesh
+    from jax.sharding import PartitionSpec as P
+
+    sess = DeviceSession(make_mesh())
+    axis = sess.axis_names[0]
+    params = init_mlp(jax.random.PRNGKey(0))
+    x = jnp.ones((64 * sess.size, 784), jnp.float32)
+    y = jnp.zeros((64 * sess.size,), jnp.int32)
+
+    def make_step(opt):
+        state = opt.init(params)
+
+        def local(params, state, x, y):
+            loss, grads = jax.value_and_grad(mlp_loss)(params, (x, y))
+            updates, state = opt.update(grads, state, params)
+            return optax.apply_updates(params, updates), state, lax.pmean(loss, axis)
+
+        step = sess.spmd(
+            local,
+            in_specs=(P(), P(), P(axis), P(axis)),
+            out_specs=(P(), P(), P()),
+        )
+        return step, state
+
+    def timeit(opt):
+        step, state = make_step(opt)
+        p = params
+        for _ in range(3):
+            p, state, loss = step(p, state, x, y)
+        float(jax.device_get(loss))
+        best = float("inf")
+        for _ in range(max(3, iters // 3)):
+            t0 = time.perf_counter()
+            for _ in range(10):
+                p, state, loss = step(p, state, x, y)
+            float(jax.device_get(loss))
+            best = min(best, (time.perf_counter() - t0) / 10)
+        return best * 1e3
+
+    base = optax.sgd(0.1)
+    t_plain = timeit(synchronous_sgd(base, axis))
+    t_gns = timeit(monitor_gradient_noise_scale(base, batch_small=64, axis_name=axis))
+    print(
+        f"RESULT: plain {t_plain:.3f} ms/step, +GNS {t_gns:.3f} ms/step, "
+        f"overhead {100 * (t_gns - t_plain) / t_plain:+.1f}% "
+        f"[GNS x{sess.size} devices]"
+    )
+
+
 def main() -> None:
     p = argparse.ArgumentParser("kungfu_tpu.benchmarks")
-    p.add_argument("--method", choices=["XLA", "HOST", "P2P"], default="XLA")
+    p.add_argument("--method", choices=["XLA", "HOST", "P2P", "GNS"], default="XLA")
     p.add_argument("--model", default="resnet50-imagenet")
     p.add_argument("--iters", type=int, default=10)
     args = p.parse_args()
@@ -122,6 +186,8 @@ def main() -> None:
         bench_xla(args.model, args.iters)
     elif args.method == "P2P":
         bench_p2p(args.model, args.iters)
+    elif args.method == "GNS":
+        bench_gns(args.iters)
     else:
         bench_host(args.model, args.iters)
 

@@ -97,3 +97,13 @@ def test_gns_in_training_step():
     assert host_state.gns.count == 5
     # noise-dominated problem: tr(S) estimate must be positive
     assert float(host_state.gns.s_ema) > 0
+
+
+def test_gns_overhead_bench_runs(capsys):
+    """The GNS-overhead harness (BASELINE.md 'GNS monitoring overhead'
+    row) runs on the CPU mesh and prints a RESULT line."""
+    from kungfu_tpu.benchmarks.__main__ import bench_gns
+
+    bench_gns(iters=3)
+    out = capsys.readouterr().out
+    assert "RESULT:" in out and "+GNS" in out

@@ -156,6 +156,34 @@ def test_shm_out_of_order_release():
 # fused group allreduce over live peer pairs
 # ---------------------------------------------------------------------------
 
+def _pair_all_reduce(a, b, x_a, x_b, name):
+    """Run one allreduce concurrently on both peers; returns (out_a,
+    out_b). Asserts the threads finished (a transport deadlock must fail
+    the test, not surface as a KeyError) and re-raises worker errors."""
+    from kungfu_tpu.base.workspace import Workspace
+
+    out = {}
+    errs = []
+
+    def run(peer, x, tag):
+        try:
+            o = np.empty_like(x)
+            peer.current_session().all_reduce(
+                Workspace(send=x, recv=o, op=ReduceOp.SUM, name=name)
+            )
+            out[tag] = o
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errs.append(e)
+
+    ta = threading.Thread(target=run, args=(a, x_a, "a"))
+    tb = threading.Thread(target=run, args=(b, x_b, "b"))
+    ta.start(); tb.start(); ta.join(60); tb.join(60)
+    assert not ta.is_alive() and not tb.is_alive(), "allreduce hung"
+    if errs:
+        raise errs[0]
+    return out["a"], out["b"]
+
+
 def test_fused_group_all_reduce_two_peers():
     """Group allreduce fuses same-dtype members and still matches numpy
     over two in-process peers with live transport."""
@@ -212,28 +240,18 @@ def test_shm_survives_connection_reset():
     try:
         big_a = np.full(200_000, 1.5, np.float32)  # 800 KB > SHM_MIN
         big_b = np.full(200_000, 2.5, np.float32)
-        out = {}
-
-        def run(peer, x, tag, name):
-            from kungfu_tpu.base.workspace import Workspace
-
-            o = np.empty_like(x)
-            peer.current_session().all_reduce(
-                Workspace(send=x, recv=o, op=ReduceOp.SUM, name=name)
-            )
-            out[tag] = o
-
         for rnd in ("r1", "r2"):
-            ta = threading.Thread(target=run, args=(a, big_a, f"a{rnd}", f"t:{rnd}"))
-            tb = threading.Thread(target=run, args=(b, big_b, f"b{rnd}", f"t:{rnd}"))
-            ta.start(); tb.start(); ta.join(60); tb.join(60)
-            assert not ta.is_alive() and not tb.is_alive(), "allreduce hung"
-            np.testing.assert_allclose(out[f"a{rnd}"], 4.0)
-            np.testing.assert_allclose(out[f"b{rnd}"], 4.0)
-            # the shm path must actually have carried the payload (the
-            # numeric result alone also passes via the socket fallback)
+            got_a, got_b = _pair_all_reduce(a, b, big_a, big_b, f"t:{rnd}")
+            np.testing.assert_allclose(got_a, 4.0)
+            np.testing.assert_allclose(got_b, 4.0)
+            # the shm path must actually have CARRIED the payload: an
+            # arena object existing is not enough (arenas are created on
+            # every new colocated connection regardless of outcome) — its
+            # allocation counter must have advanced
             if shm.enabled():
-                assert a.client._arenas, "shm path not taken"
+                assert any(
+                    ar._alloc > 0 for ar in a.client._arenas.values()
+                ), "shm path not taken"
             if rnd == "r1":
                 # simulate the epoch boundary both peers go through on a
                 # resize: drop pooled connections and arenas
@@ -258,23 +276,9 @@ def test_shm_ring_full_falls_back_to_socket(monkeypatch):
     try:
         big_a = np.full(150_000, 1.0, np.float32)
         big_b = np.full(150_000, 2.0, np.float32)
-        out = {}
-
-        def run(peer, x, tag):
-            from kungfu_tpu.base.workspace import Workspace
-
-            o = np.empty_like(x)
-            peer.current_session().all_reduce(
-                Workspace(send=x, recv=o, op=ReduceOp.SUM, name="fb")
-            )
-            out[tag] = o
-
-        ta = threading.Thread(target=run, args=(a, big_a, "a"))
-        tb = threading.Thread(target=run, args=(b, big_b, "b"))
-        ta.start(); tb.start(); ta.join(60); tb.join(60)
-        assert not ta.is_alive() and not tb.is_alive(), "fallback hung"
-        np.testing.assert_allclose(out["a"], 3.0)
-        np.testing.assert_allclose(out["b"], 3.0)
+        got_a, got_b = _pair_all_reduce(a, b, big_a, big_b, "fb")
+        np.testing.assert_allclose(got_a, 3.0)
+        np.testing.assert_allclose(got_b, 3.0)
     finally:
         a.stop()
         b.stop()
