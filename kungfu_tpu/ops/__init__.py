@@ -14,7 +14,7 @@ from kungfu_tpu.ops.hierarchical import (
     synchronous_sgd_hierarchical,
 )
 from kungfu_tpu.ops.flash_attention import flash_attention
-from kungfu_tpu.ops.moe import switch_moe
+from kungfu_tpu.ops.moe import moe_ffn, switch_moe
 from kungfu_tpu.ops.ring_attention import ring_self_attention
 
 __all__ = [
@@ -30,6 +30,7 @@ __all__ = [
     "make_hier_train_step",
     "synchronous_sgd_hierarchical",
     "ring_self_attention",
+    "moe_ffn",
     "switch_moe",
     "flash_attention",
 ]

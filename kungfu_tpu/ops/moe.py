@@ -1,20 +1,24 @@
-"""Expert parallelism: switch-routed MoE FFN with all_to_all dispatch.
+"""Expert parallelism: top-k routed MoE FFN with all_to_all dispatch.
 
 Beyond-reference capability (the reference is data-parallel only,
 SURVEY §2.4); on TPU the expert dimension is a mesh axis and token
 dispatch is `lax.all_to_all` over ICI — the canonical TPU MoE layout
-(one expert group per device, capacity-bounded buckets).
+(per-device expert groups, capacity-bounded buckets).
 
-Top-1 (switch) routing with capacity dropping: each shard routes its
-tokens, packs them into per-expert capacity buckets, exchanges buckets
-with every peer via all_to_all, applies its local expert, and sends the
-results back the way they came. Dropped tokens (over capacity) pass
-through on the residual path (combine weight 0), the standard switch
-behavior.
+`moe_ffn` is the general form: E = axis_size * experts_per_device global
+experts, top_k ∈ {1, 2} routing with renormalized gates, capacity
+dropping per (source shard, choice). Each shard packs its tokens into
+per-expert capacity buckets (choices side by side on the bucket axis so
+ONE all_to_all carries both), exchanges buckets with every peer, applies
+its local expert stack as one batched einsum, and sends results back the
+way they came. Dropped tokens (over capacity) pass through on the
+residual path (combine weight 0), the standard switch behavior; a top-2
+token keeps whichever of its choices fit.
 
-Runs INSIDE a shard_map over the expert axis. Experts = axis size (one
-expert per device); generalizing to k experts/device stacks an extra
-leading dim on the expert weights.
+`switch_moe` (top-1, one expert per device) is the round-4 surface,
+preserved as a thin special case.
+
+Runs INSIDE a shard_map over the expert axis.
 """
 
 from __future__ import annotations
@@ -24,54 +28,86 @@ import jax.numpy as jnp
 from jax import lax
 
 
-def switch_moe(x, router_w, w_in, w_out, axis_name: str, axis_size: int,
-               capacity_factor: float = 1.25):
-    """x (T, D) tokens on this shard; router_w (D, E); w_in (D, F),
-    w_out (F, D) are THIS device's expert. E == axis_size. Returns
+def moe_ffn(x, router_w, w_in, w_out, axis_name: str, axis_size: int,
+            top_k: int = 1, capacity_factor: float = 1.25):
+    """x (T, D) tokens on this shard; router_w (D, E).
+
+    w_in (epd, D, F), w_out (epd, F, D) are THIS device's expert stack
+    (leading dim = experts per device); E = axis_size * epd. Returns
     (out (T, D), aux_loss) — out is zero for dropped tokens (caller adds
-    the residual), aux_loss is the switch load-balancing loss."""
+    the residual), aux_loss is the switch load-balancing loss on the
+    primary choice."""
+    if top_k not in (1, 2):
+        raise ValueError(f"top_k must be 1 or 2, got {top_k}")
     T, D = x.shape
-    E = axis_size
-    C = max(1, int(capacity_factor * T / E))  # per (src, expert) capacity
+    epd = w_in.shape[0]
+    E = axis_size * epd
+    if router_w.shape[-1] != E:
+        raise ValueError(
+            f"router width {router_w.shape[-1]} != axis_size*epd = {E}"
+        )
+    C = max(1, int(capacity_factor * T / E))  # per (shard, choice) capacity
+    K = top_k * C  # bucket slots per expert on the wire
 
-    logits = (x.astype(jnp.float32) @ router_w.astype(jnp.float32))
+    logits = x.astype(jnp.float32) @ router_w.astype(jnp.float32)
     probs = jax.nn.softmax(logits, axis=-1)  # (T, E)
-    expert = jnp.argmax(probs, axis=-1)  # (T,)
-    gate = jnp.take_along_axis(probs, expert[:, None], axis=-1)[:, 0]  # (T,)
-
-    # position of each token within its expert's capacity bucket
-    onehot = jax.nn.one_hot(expert, E, dtype=jnp.int32)  # (T, E)
-    pos = jnp.cumsum(onehot, axis=0) * onehot  # 1-based slot per token
-    slot = jnp.sum(pos, axis=-1) - 1  # (T,) 0-based; may exceed C-1
-    kept = slot < C
-
-    # pack: send[e, c] = the c-th kept token routed to expert e
-    send = jnp.zeros((E, C, D), x.dtype)
-    scat_e = jnp.where(kept, expert, 0)
-    scat_c = jnp.where(kept, slot, 0)
-    send = send.at[scat_e, scat_c].add(
-        jnp.where(kept[:, None], x, 0), mode="drop"
+    top_probs, top_idx = lax.top_k(probs, top_k)  # (T, top_k)
+    # top-1 keeps the RAW router prob as its gate (switch semantics);
+    # top-2 renormalizes over the chosen pair (GShard/Mixtral combine)
+    gates = (
+        top_probs
+        if top_k == 1
+        else top_probs / jnp.sum(top_probs, axis=-1, keepdims=True)
     )
 
-    # exchange: recv[s, c] = bucket sent BY shard s TO my expert
+    send = jnp.zeros((E, K, D), x.dtype)
+    scat = []
+    for j in range(top_k):
+        expert_j = top_idx[:, j]  # (T,)
+        onehot = jax.nn.one_hot(expert_j, E, dtype=jnp.int32)
+        pos = jnp.cumsum(onehot, axis=0) * onehot
+        slot = jnp.sum(pos, axis=-1) - 1  # 0-based within (expert, choice)
+        kept = slot < C
+        se = jnp.where(kept, expert_j, 0)
+        sc = jnp.where(kept, j * C + slot, 0)
+        send = send.at[se, sc].add(jnp.where(kept[:, None], x, 0),
+                                   mode="drop")
+        scat.append((se, sc, kept))
+
+    # exchange: group bucket rows by destination DEVICE (expert e lives on
+    # device e // epd at local index e % epd)
+    send = send.reshape(axis_size, epd, K, D)
     recv = lax.all_to_all(send, axis_name, split_axis=0, concat_axis=0,
-                          tiled=False)
-    # expert FFN on every received token: (E, C, D) -> (E, C, D)
-    h = jax.nn.gelu(recv @ w_in.astype(recv.dtype))
-    y = h @ w_out.astype(recv.dtype)
-    # return to senders
+                          tiled=False)  # (axis_size, epd, K, D)
+    # local expert stack as one batched einsum over the epd dim
+    h = jax.nn.gelu(
+        jnp.einsum("sjkd,jdf->sjkf", recv, w_in.astype(recv.dtype))
+    )
+    y = jnp.einsum("sjkf,jfd->sjkd", h, w_out.astype(recv.dtype))
     back = lax.all_to_all(y, axis_name, split_axis=0, concat_axis=0,
-                          tiled=False)  # (E, C, D): my tokens, per expert
+                          tiled=False)
+    back = back.reshape(E, K, D)  # my tokens' results, per (expert, slot)
 
-    # unpack: token t's result lives at back[expert[t], slot[t]]
-    out = back[scat_e, scat_c]  # (T, D)
-    out = jnp.where(kept[:, None], out, 0).astype(x.dtype)
-    out = out * gate[:, None].astype(x.dtype)
+    out = jnp.zeros((T, D), x.dtype)
+    for j, (se, sc, kept) in enumerate(scat):
+        got = back[se, sc]  # (T, D)
+        got = jnp.where(kept[:, None], got, 0)
+        out = out + got.astype(x.dtype) * gates[:, j, None].astype(x.dtype)
 
-    # switch aux loss: E * sum_e fraction_tokens_e * mean_prob_e, averaged
-    # over shards (identical formula on every shard after the pmean)
-    frac = jnp.mean(onehot.astype(jnp.float32), axis=0)
+    # switch aux loss on the primary choice: E * sum_e frac_e * mean_prob_e
+    onehot1 = jax.nn.one_hot(top_idx[:, 0], E, dtype=jnp.float32)
+    frac = jnp.mean(onehot1, axis=0)
     mean_prob = jnp.mean(probs, axis=0)
     aux = E * jnp.sum(frac * mean_prob)
     aux = lax.pmean(aux, axis_name)
     return out, aux
+
+
+def switch_moe(x, router_w, w_in, w_out, axis_name: str, axis_size: int,
+               capacity_factor: float = 1.25):
+    """Top-1 switch MoE with one expert per device (the round-4 surface):
+    w_in (D, F), w_out (F, D). See `moe_ffn` for the general form."""
+    return moe_ffn(
+        x, router_w, w_in[None], w_out[None], axis_name, axis_size,
+        top_k=1, capacity_factor=capacity_factor,
+    )

@@ -14,17 +14,9 @@ def _ep_mesh(ep):
 
 
 def _dense_reference(x_all, router_w, w_in_all, w_out_all):
-    """Every token through its argmax expert, gate-scaled (no drops)."""
-    logits = x_all.astype(np.float32) @ np.asarray(router_w, np.float32)
-    probs = np.exp(logits - logits.max(-1, keepdims=True))
-    probs /= probs.sum(-1, keepdims=True)
-    e = probs.argmax(-1)
-    gate = probs[np.arange(len(e)), e]
-    out = np.zeros_like(x_all, dtype=np.float32)
-    for i, (ei, g) in enumerate(zip(e, gate)):
-        h = jax.nn.gelu(x_all[i].astype(np.float32) @ np.asarray(w_in_all[ei], np.float32))
-        out[i] = (np.asarray(h) @ np.asarray(w_out_all[ei], np.float32)) * g
-    return out
+    """Every token through its argmax expert, gate-scaled (no drops) —
+    the top_k=1 case of _dense_topk_reference."""
+    return _dense_topk_reference(x_all, router_w, w_in_all, w_out_all, 1)
 
 
 def _run_moe(x, router_w, w_in_all, w_out_all, ep, capacity_factor):
@@ -111,3 +103,112 @@ def test_switch_moe_differentiable():
     # expert weights receive gradient (tokens actually flowed through)
     assert float(jnp.abs(g[1]).sum()) > 0
     assert float(jnp.abs(g[0]).sum()) > 0  # router learns via the gate
+
+
+def _dense_topk_reference(x_all, router_w, w_in_all, w_out_all, top_k):
+    """Every token through its top-k experts, renormalized gates, no
+    drops (numpy reference for moe_ffn)."""
+    logits = x_all.astype(np.float32) @ np.asarray(router_w, np.float32)
+    probs = np.exp(logits - logits.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    order = np.argsort(-probs, axis=-1)[:, :top_k]
+    out = np.zeros_like(x_all, dtype=np.float32)
+    for i in range(len(x_all)):
+        chosen = order[i]
+        g = probs[i, chosen]
+        if top_k > 1:
+            g = g / g.sum()
+        for ei, gi in zip(chosen, g):
+            h = jax.nn.gelu(
+                x_all[i].astype(np.float32) @ np.asarray(w_in_all[ei], np.float32)
+            )
+            out[i] += (np.asarray(h) @ np.asarray(w_out_all[ei], np.float32)) * gi
+    return out
+
+
+def _run_moe_general(x, router_w, w_in_all, w_out_all, ep, top_k,
+                     capacity_factor):
+    from kungfu_tpu.ops.moe import moe_ffn
+
+    mesh = _ep_mesh(ep)
+
+    def shard_fn(x_sh, router_w, w_in_sh, w_out_sh):
+        # w_*_sh arrive with a leading (1,) shard axis over the (epd, ...)
+        # expert stack
+        return moe_ffn(
+            x_sh, router_w, w_in_sh[0], w_out_sh[0], "ep", ep,
+            top_k=top_k, capacity_factor=capacity_factor,
+        )
+
+    fn = jax.jit(
+        shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("ep"), P(), P("ep"), P("ep")),
+            out_specs=(P("ep"), P()),
+            check_vma=False,
+        )
+    )
+    return fn(x, router_w, w_in_all, w_out_all)
+
+
+def test_moe_top2_matches_dense_when_no_drops():
+    ep, T, D, F = 4, 32, 8, 16
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, D), jnp.float32)
+    router_w = jax.random.normal(jax.random.PRNGKey(1), (D, ep), jnp.float32)
+    w_in = jax.random.normal(jax.random.PRNGKey(2), (ep, D, F), jnp.float32) * 0.3
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (ep, F, D), jnp.float32) * 0.3
+    out, aux = _run_moe_general(
+        x, router_w, w_in.reshape(ep, 1, D, F), w_out.reshape(ep, 1, F, D),
+        ep, top_k=2, capacity_factor=float(ep),
+    )
+    ref = _dense_topk_reference(np.asarray(x), router_w, w_in, w_out, top_k=2)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+    assert np.isfinite(float(aux)) and float(aux) > 0
+
+
+def test_moe_multiple_experts_per_device():
+    ep, epd, T, D, F = 4, 2, 32, 8, 16
+    E = ep * epd
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, D), jnp.float32)
+    router_w = jax.random.normal(jax.random.PRNGKey(1), (D, E), jnp.float32)
+    w_in = jax.random.normal(jax.random.PRNGKey(2), (E, D, F), jnp.float32) * 0.3
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (E, F, D), jnp.float32) * 0.3
+    out, aux = _run_moe_general(
+        x, router_w,
+        w_in.reshape(ep, epd, D, F), w_out.reshape(ep, epd, F, D),
+        ep, top_k=1, capacity_factor=float(E),
+    )
+    ref = _dense_reference(np.asarray(x), router_w, w_in, w_out)
+    np.testing.assert_allclose(np.asarray(out), ref, rtol=1e-4, atol=1e-5)
+
+
+def test_moe_top2_differentiable():
+    ep, T, D, F = 2, 16, 8, 8
+    from kungfu_tpu.ops.moe import moe_ffn
+
+    mesh = _ep_mesh(ep)
+    x = jax.random.normal(jax.random.PRNGKey(0), (T, D), jnp.float32)
+    router_w = jax.random.normal(jax.random.PRNGKey(1), (D, ep), jnp.float32)
+    w_in = jax.random.normal(jax.random.PRNGKey(2), (ep, 1, D, F), jnp.float32) * 0.3
+    w_out = jax.random.normal(jax.random.PRNGKey(3), (ep, 1, F, D), jnp.float32) * 0.3
+
+    def loss(params):
+        w_in, w_out, router_w = params
+
+        def shard_fn(x_sh, router_w, w_in_sh, w_out_sh):
+            out, aux = moe_ffn(x_sh, router_w, w_in_sh[0], w_out_sh[0],
+                               "ep", ep, top_k=2, capacity_factor=2.0)
+            return jnp.sum(out ** 2) + 0.01 * aux
+
+        fn = shard_map(
+            shard_fn, mesh=mesh,
+            in_specs=(P("ep"), P(), P("ep"), P("ep")),
+            out_specs=P(),
+            check_vma=False,
+        )
+        return fn(x, router_w, w_in, w_out)
+
+    g = jax.jit(jax.grad(loss))((w_in, w_out, router_w))
+    for t in g:
+        assert np.all(np.isfinite(np.asarray(t)))
+    assert float(np.abs(np.asarray(g[0])).sum()) > 0
