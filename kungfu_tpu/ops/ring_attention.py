@@ -7,8 +7,9 @@ attention over sequences larger than one chip's memory. This is the ring
 algorithm (Liu et al., "Ring Attention with Blockwise Transformers"): each
 device holds one sequence block of Q/K/V; K/V blocks rotate around the
 ring via `ppermute` while each device accumulates its queries' attention
-over every block with an online (flash-style) softmax — peak memory is
-O(S_local^2) scores instead of O(S^2), and the ring rides the ICI
+over every block with an online (flash-style) softmax, streaming each
+held block through in blk_k-sized sub-tiles — peak memory is
+O(S_local x blk_k) scores instead of O(S^2), and the ring rides the ICI
 bidirectionally.
 
 Runs INSIDE a `shard_map` over the sequence axis. Accumulation is f32
@@ -25,11 +26,21 @@ NEG_INF = -1e30  # finite: exp(NEG_INF - NEG_INF) must be well-defined
 
 
 def ring_self_attention(q, k, v, axis_name: str, axis_size: int,
-                        causal: bool = True):
+                        causal: bool = True, blk_k: int = 1024):
     """Exact attention for sequence-sharded q, k, v of shape
     (B, H, S_local, head_dim); the global sequence is axis_size * S_local
     with device i (by `lax.axis_index`) holding block i. Returns the
-    (B, H, S_local, head_dim) context in q's dtype."""
+    (B, H, S_local, head_dim) context in q's dtype.
+
+    Within each ring step the held K/V block streams through in
+    `blk_k`-sized sub-blocks (an inner online-softmax scan), so the score
+    tensor is (S_local, blk_k) instead of (S_local, S_local) — the
+    "blockwise transformers" half of the ring-attention paper. At
+    S_local=8192, B=1, H=8 that is a 2 GiB dense f32 score buffer vs
+    256 MiB tiled at blk_k=1024; for S_local <= blk_k the loop has one
+    iteration and this is exactly the r4 formulation. A ragged
+    S_local % blk_k shrinks blk_k to the largest divisor so streaming is
+    never silently abandoned."""
     B, H, Sl, hd = q.shape
     out_dtype = q.dtype
     idx = lax.axis_index(axis_name)
@@ -39,26 +50,49 @@ def ring_self_attention(q, k, v, axis_name: str, axis_size: int,
 
     perm = [(i, (i + 1) % axis_size) for i in range(axis_size)]
 
-    def accumulate(k_blk, v_blk, blk, m, l, o):
-        kpos = blk * Sl + jnp.arange(Sl)[None, :]  # (1, Sl) global key pos
+    blk_k = min(blk_k, Sl)
+    while Sl % blk_k:
+        blk_k -= 1  # largest divisor of Sl <= requested blk_k
+    n_sub = Sl // blk_k
+
+    def sub_accumulate(k_sub, v_sub, kpos, m, l, o):
+        """One (Sl, blk_k) score tile of the online softmax."""
         scores = (
-            jnp.einsum("bhqd,bhkd->bhqk", qf, k_blk.astype(jnp.float32))
+            jnp.einsum("bhqd,bhkd->bhqk", qf, k_sub.astype(jnp.float32))
             * scale
         )
         if causal:
-            mask = kpos <= qpos  # (Sl, Sl)
+            mask = kpos <= qpos  # (Sl, blk_k)
             scores = jnp.where(mask, scores, NEG_INF)
             maskf = mask.astype(jnp.float32)
         else:
-            maskf = jnp.ones((Sl, Sl), jnp.float32)
+            maskf = jnp.ones(scores.shape[-2:], jnp.float32)
         m_new = jnp.maximum(m, jnp.max(scores, axis=-1, keepdims=True))
-        # p is explicitly zeroed on masked entries: when a block is fully
+        # p is explicitly zeroed on masked entries: when a tile is fully
         # masked m_new stays NEG_INF and exp(scores - m_new) would be 1
         p = jnp.exp(scores - m_new) * maskf
         corr = jnp.exp(m - m_new)
         l = l * corr + jnp.sum(p, axis=-1, keepdims=True)
-        o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_blk.astype(jnp.float32))
+        o = o * corr + jnp.einsum("bhqk,bhkd->bhqd", p, v_sub.astype(jnp.float32))
         return m_new, l, o
+
+    def accumulate(k_blk, v_blk, blk, m, l, o):
+        if n_sub == 1:
+            kpos = blk * Sl + jnp.arange(Sl)[None, :]
+            return sub_accumulate(k_blk, v_blk, kpos, m, l, o)
+        k_subs = k_blk.reshape(B, H, n_sub, blk_k, hd).transpose(2, 0, 1, 3, 4)
+        v_subs = v_blk.reshape(B, H, n_sub, blk_k, hd).transpose(2, 0, 1, 3, 4)
+
+        def body(carry, inp):
+            m, l, o = carry
+            k_sub, v_sub, j = inp
+            kpos = blk * Sl + j * blk_k + jnp.arange(blk_k)[None, :]
+            return sub_accumulate(k_sub, v_sub, kpos, m, l, o), None
+
+        (m, l, o), _ = lax.scan(
+            body, (m, l, o), (k_subs, v_subs, jnp.arange(n_sub))
+        )
+        return m, l, o
 
     def body(step, carry):
         # rotate FIRST (permute-before-compute): steps 1..n-1 do exactly
