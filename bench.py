@@ -55,7 +55,9 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 import optax
-from jax import lax, shard_map
+from jax import lax
+
+from kungfu_tpu.parallel._compat import shard_map
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 BASELINE_IMG_PER_SEC = 350.0  # TF ResNet-50 fp32 on V100, reference era

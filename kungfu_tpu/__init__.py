@@ -26,5 +26,16 @@ __all__ = [
     "DType",
     "ReduceOp",
     "Strategy",
+    "telemetry",
     "__version__",
 ]
+
+
+def __getattr__(name):
+    # lazy (PEP 562): kungfu_tpu.telemetry without paying for it on
+    # import paths that never touch it
+    if name == "telemetry":
+        import kungfu_tpu.telemetry as telemetry
+
+        return telemetry
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")

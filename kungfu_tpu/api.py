@@ -180,6 +180,31 @@ def trace_summary(prefix: str = "") -> dict:
     return trace.summary_ms(prefix)
 
 
+def telemetry_dump(prefix: str = "") -> dict:
+    """Snapshot of the whole telemetry subsystem: Prometheus metrics
+    text, Chrome-trace JSON, resize audit records and a per-span ms
+    summary (see kungfu_tpu.telemetry.dump)."""
+    from kungfu_tpu import telemetry
+
+    return telemetry.dump(prefix)
+
+
+def resize_audit() -> list:
+    """The elastic resize audit records of this process, as dicts
+    (old/new cluster, trigger, per-phase durations, progress)."""
+    from kungfu_tpu.telemetry import audit
+
+    return [r.to_json() for r in audit.records(kind="resize")]
+
+
+def metrics_text() -> str:
+    """Prometheus text exposition of the process metrics registry — the
+    same body the per-worker /metrics endpoint serves."""
+    from kungfu_tpu.telemetry import metrics
+
+    return metrics.render()
+
+
 def change_cluster(progress: int):
     return get_default_peer().change_cluster(progress)
 
@@ -288,7 +313,8 @@ def round_robin_peer(step: int) -> int:
 def egress_rates() -> "np.ndarray":
     """Per-peer egress rates (bytes/sec), rank-aligned (parity:
     EgressRates op, ops/cpu/monitoring.cpp:5-22 + sess.GetEgressRates).
-    All zeros unless KF_CONFIG_ENABLE_MONITORING is set."""
+    All zeros unless monitoring is on (KF_CONFIG_ENABLE_MONITORING
+    truthy or KF_TELEMETRY=metrics)."""
     from kungfu_tpu.monitor.net import get_monitor
 
     sess = get_default_peer().current_session()

@@ -15,6 +15,8 @@ import time
 
 import numpy as np
 
+from kungfu_tpu.telemetry import log
+
 
 def bench_xla(model: str, iters: int, warmup: int = 3) -> None:
     import jax
@@ -48,7 +50,7 @@ def bench_xla(model: str, iters: int, warmup: int = 3) -> None:
         # algorithm bandwidth: 2(n-1)/n factors omitted — report bus data rate
         samples.append(total_bytes / dt / (1 << 30))
     mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
-    print(f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) [XLA x{n} devices, {model}]")
+    log.echo(f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) [XLA x{n} devices, {model}]")
 
 
 def bench_host(model: str, iters: int, warmup: int = 2) -> None:
@@ -73,7 +75,7 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
     mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
     if api.current_rank() == 0:
         med = float(np.median(samples))
-        print(
+        log.echo(
             f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) median {med:.3f} "
             f"[HOST x{api.cluster_size()} workers, {model}]"
         )
@@ -81,7 +83,7 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
         summary = api.trace_summary()
         top = sorted(summary.items(), key=lambda kv: -kv[1])[:6]
         for name, ms in top:
-            print(f"TRACE {name}: {ms:.0f} ms")
+            log.echo(f"TRACE {name}: {ms:.0f} ms")
 
 
 def bench_p2p(model: str, iters: int) -> None:
@@ -106,7 +108,7 @@ def bench_p2p(model: str, iters: int) -> None:
     api.run_barrier()
     mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
     if rank == 0:
-        print(
+        log.echo(
             f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) "
             f"[P2P x{size} workers, {model}]"
         )
@@ -169,7 +171,7 @@ def bench_gns(iters: int) -> None:
     base = optax.sgd(0.1)
     t_plain = timeit(synchronous_sgd(base, axis))
     t_gns = timeit(monitor_gradient_noise_scale(base, batch_small=64, axis_name=axis))
-    print(
+    log.echo(
         f"RESULT: plain {t_plain:.3f} ms/step, +GNS {t_gns:.3f} ms/step, "
         f"overhead {100 * (t_gns - t_plain) / t_plain:+.1f}% "
         f"[GNS x{sess.size} devices]"

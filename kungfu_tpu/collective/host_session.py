@@ -23,6 +23,8 @@ from typing import Callable, Dict, List, Optional, Sequence
 import numpy as np
 
 from kungfu_tpu.base.ops import ReduceOp, reduce_inplace, transform_n
+from kungfu_tpu.telemetry import config as tconfig
+from kungfu_tpu.telemetry import metrics as tmetrics
 from kungfu_tpu.utils import trace
 from kungfu_tpu.base.strategy import Strategy
 from kungfu_tpu.collective.adaptive import AdaptiveState
@@ -120,6 +122,34 @@ def _buf(arr: np.ndarray):
         return arr.tobytes()
 
 
+class _CollectiveScope:
+    """Span + latency-histogram wrapper around one public collective
+    (plain classes end to end — tracing._Span underneath is also
+    class-based — so the per-call telemetry cost stays at two clock
+    reads, a deque append and an optional histogram observe)."""
+
+    __slots__ = ("_sess", "_kind", "_span", "_t0")
+
+    def __init__(self, sess: "HostSession", kind: str, nbytes: int):
+        self._sess = sess
+        self._kind = kind
+        self._span = trace.span(
+            f"collective.{kind}", bytes=int(nbytes), size=sess.size
+        )
+
+    def __enter__(self):
+        self._t0 = time.perf_counter()
+        self._span.__enter__()
+        return self
+
+    def __exit__(self, *exc):
+        self._span.__exit__(*exc)
+        hist = self._sess._coll_hist
+        if hist is not None:
+            hist.labels(self._kind).observe(time.perf_counter() - self._t0)
+        return False
+
+
 
 class HostSession:
     """One collective epoch over a fixed PeerList."""
@@ -165,6 +195,18 @@ class HostSession:
         self._candidates_built: dict = {0: self.global_strategies}
         self.adaptive = AdaptiveState(len(self._candidate_names))
         self._tree_override = False
+        # per-collective latency histogram (telemetry): one observe per
+        # COLLECTIVE call (not per message), gated off with the rest of
+        # the metrics so the steady-state walk stays untouched
+        self._coll_hist = (
+            tmetrics.histogram(
+                "kungfu_collective_latency_seconds",
+                "Host-plane collective latency by kind",
+                ("collective",),
+            )
+            if tconfig.metrics_enabled()
+            else None
+        )
 
     def _candidate(self, idx: int) -> List[st.StrategyPair]:
         if idx not in self._candidates_built:
@@ -180,13 +222,20 @@ class HostSession:
     def close(self) -> None:
         pass
 
+    def _collected(self, kind: str, nbytes: int):
+        """Telemetry wrapper for one public collective: a named span
+        (feeding /trace) plus a latency-histogram observation when
+        metrics are on. Returns a context manager."""
+        return _CollectiveScope(self, kind, nbytes)
+
     # ------------------------------------------------------------------
     # public collectives
     # ------------------------------------------------------------------
 
     def all_reduce(self, w: Workspace) -> None:
-        with stall_detect(f"all_reduce({w.name})"):
-            self._run_strategies(w, self.global_strategies)
+        with self._collected("all_reduce", w.recv.nbytes):
+            with stall_detect(f"all_reduce({w.name})"):
+                self._run_strategies(w, self.global_strategies)
 
     # concurrent workspaces per batch in group ops: concurrency only pays
     # when cores exist to run the walks (on a 1-core host it just adds
@@ -213,7 +262,9 @@ class HostSession:
         srcs/python/kungfu/tensorflow/v1/benchmarks)."""
         if not ws:
             return
-        with stall_detect(f"group_all_reduce[{len(ws)}]"):
+        with self._collected(
+            "group_all_reduce", sum(w.recv.nbytes for w in ws)
+        ), stall_detect(f"group_all_reduce[{len(ws)}]"):
             singles: List[Workspace] = []
             groups: Dict[tuple, List[Workspace]] = {}
             for w in ws:
@@ -283,8 +334,9 @@ class HostSession:
         runMonitoredStrategies, session/monitoring.go:15-35)."""
         nbytes = w.recv.size * w.recv.itemsize
         t0 = time.perf_counter()
-        with stall_detect(f"monitored_all_reduce({w.name})"):
-            self._run_strategies(w, self.global_strategies)
+        with self._collected("monitored_all_reduce", nbytes):
+            with stall_detect(f"monitored_all_reduce({w.name})"):
+                self._run_strategies(w, self.global_strategies)
         self.adaptive.current.update(nbytes, time.perf_counter() - t0)
 
     def check_interference(self, vote_tag: str = "") -> bool:
@@ -304,6 +356,7 @@ class HostSession:
         )
         if int(votes_out[0]) * 2 <= self.size:
             return False
+        old_name = self._candidate_names[self.adaptive.active].name
         idx = self.adaptive.advance()
         self.global_strategies = self._candidate(idx)
         # safety: all peers must now run the same graphs
@@ -311,6 +364,16 @@ class HostSession:
             st.digest(self.global_strategies), f":switch:{self.adaptive.switch_count}"
         ):
             raise RuntimeError("strategy switch diverged across peers")
+        from kungfu_tpu.telemetry import audit as _audit
+
+        _audit.record_event(
+            "strategy_switch",
+            peer=str(self.self_id),
+            trigger="interference_vote",
+            old_strategy=old_name,
+            new_strategy=self._candidate_names[idx].name,
+            switch_count=self.adaptive.switch_count,
+        )
         return True
 
     def active_strategy(self) -> Optional[Strategy]:
@@ -372,15 +435,16 @@ class HostSession:
             self._run_graphs(w, [g])
 
     def broadcast(self, w: Workspace, root: int = 0) -> None:
-        if root == 0:
-            self._run_graphs(w, [self.global_strategies[0].bcast_graph])
-        else:
-            self._check_root(root)
-            from kungfu_tpu.plan import topology as _topo
+        with self._collected("broadcast", w.recv.nbytes):
+            if root == 0:
+                self._run_graphs(w, [self.global_strategies[0].bcast_graph])
+            else:
+                self._check_root(root)
+                from kungfu_tpu.plan import topology as _topo
 
-            self._run_graphs(
-                w, [_topo.gen_star_bcast_graph(self.size, root)]
-            )
+                self._run_graphs(
+                    w, [_topo.gen_star_bcast_graph(self.size, root)]
+                )
 
     def _check_root(self, root: int) -> None:
         if not 0 <= root < self.size:
@@ -469,10 +533,13 @@ class HostSession:
         the same message framing)."""
         self._check_root(root)
         if self.rank != root:
-            self.client.send(
-                self.peers[root], w.name, _buf(w.send), ConnType.COLLECTIVE
-            )
+            with self._collected("gather", w.send.nbytes):
+                self.client.send(
+                    self.peers[root], w.name, _buf(w.send), ConnType.COLLECTIVE
+                )
             return
+        scope = self._collected("gather", w.recv.nbytes)
+        scope.__enter__()
         cancel = threading.Event()
         parts: List[Optional[np.ndarray]] = [None] * len(self.peers)
         releases: List = [None] * len(self.peers)
@@ -514,6 +581,7 @@ class HostSession:
             for rel in releases:
                 if rel is not None:
                     rel()
+            scope.__exit__(None, None, None)
 
     def all_gather(self, w: Workspace) -> None:
         """Gather to root then broadcast the concatenation (parity:

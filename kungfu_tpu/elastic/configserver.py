@@ -74,6 +74,14 @@ class _Handler(BaseHTTPRequestHandler):
         except (ValueError, ClusterError, json.JSONDecodeError) as e:
             self._reply(400, json.dumps({"error": str(e)}).encode())
             return
+        from kungfu_tpu.telemetry import audit
+
+        audit.record_event(
+            "config_put",
+            trigger="http",
+            version=version,
+            size=len(cluster.workers),
+        )
         self._reply(200, json.dumps({"Version": version}).encode())
 
     def do_POST(self):
@@ -124,7 +132,9 @@ def main(argv=None) -> None:
             initial = Cluster.loads(f.read())
     srv = ConfigServer(args.port, initial)
     srv.start()
-    print(f"config server on :{srv.port}")
+    from kungfu_tpu.telemetry import log
+
+    log.echo(f"config server on :{srv.port}")
     srv.stop_event.wait()
 
 

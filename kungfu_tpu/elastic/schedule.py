@@ -99,13 +99,17 @@ class StepBasedSchedule:
             # transient config-server blip: _last_proposed stays unset so
             # the very next maybe_propose call retries the PUT; warn so a
             # PERSISTENT failure is distinguishable from a spent schedule
-            import sys
+            from kungfu_tpu.telemetry import log
 
-            print(
-                f"kungfu: propose_new_size({target}) failed ({e}); will retry",
-                file=sys.stderr,
-            )
+            log.warn("propose_new_size(%d) failed (%s); will retry", target, e)
             return None
+        from kungfu_tpu.telemetry import log, metrics
+
+        metrics.counter(
+            "kungfu_schedule_proposals_total",
+            "Cluster sizes proposed by the step-based schedule",
+        ).inc()
+        log.info("schedule proposed cluster size %d at progress %d", target, step)
         self._last_proposed = target
         self._proposed_at = time.monotonic()
         return target

@@ -42,6 +42,14 @@ class ElasticState:
         self._stop_reason: Optional[str] = None
         self._get_state: Optional[Callable] = None
         self._set_state: Optional[Callable] = None
+        # last checkpoint version this driver saved/restored (stamped
+        # onto resize audit records); None until note_checkpoint()
+        self._checkpoint_version: Optional[int] = None
+
+    def note_checkpoint(self, version: int) -> None:
+        """Tell the elastic driver which checkpoint version now covers
+        `progress` — recorded on the next resize's audit entry."""
+        self._checkpoint_version = int(version)
 
     def register_state(self, get_state: Callable, set_state: Callable) -> None:
         """Register training-state callbacks for joiner re-sync.
@@ -126,6 +134,17 @@ class ElasticState:
                 self._stop_reason = "reload"
             return
         changed, detached = api.resize()
+        if changed:
+            # the resize audit record was written deep in the peer
+            # protocol; only the elastic driver knows the training
+            # progress (and checkpoint version) it happened at
+            from kungfu_tpu.telemetry import audit
+
+            audit.annotate_last(
+                peer=str(self._peer.self_id),
+                progress=self.progress,
+                checkpoint_version=self._checkpoint_version,
+            )
         if detached:
             self._stop_reason = "detached"
         elif changed:

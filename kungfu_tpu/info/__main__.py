@@ -1,8 +1,13 @@
-"""Usage: python3 -m kungfu_tpu.info [--no-devices]
+"""Usage: python3 -m kungfu_tpu.info [--no-devices] [--telemetry [URL]]
 
 Prints framework, backend and cluster-env diagnostics (parity:
 python -m kungfu.info; the CUDA/NCCL/TF report becomes JAX/TPU/KF_* —
-what an operator actually needs when a TPU-VM worker misbehaves)."""
+what an operator actually needs when a TPU-VM worker misbehaves).
+
+--telemetry shows the telemetry configuration (KF_TELEMETRY features,
+endpoint scheme) and, given a worker URL (http://host:port — the
+worker's peer port + 10000), fetches and prints its live /metrics
+page."""
 
 import os
 import sys
@@ -53,11 +58,44 @@ def _show_cluster_env() -> None:
         print(f"  {k}={kf[k]}")
 
 
+def _show_telemetry(argv) -> None:
+    from kungfu_tpu import telemetry
+
+    feats = sorted(telemetry.features())
+    print(f"telemetry: {','.join(feats) if feats else 'off'} "
+          f"(KF_TELEMETRY={os.environ.get('KF_TELEMETRY', '')!r})")
+    print("telemetry endpoints: http://<worker>:<peer_port+10000>"
+          "/metrics | /trace | /audit")
+    # an URL argument right after --telemetry: scrape a live worker
+    idx = argv.index("--telemetry")
+    url = argv[idx + 1] if idx + 1 < len(argv) else ""
+    if url.startswith("http"):
+        import urllib.request
+
+        try:
+            with urllib.request.urlopen(
+                url.rstrip("/") + "/metrics", timeout=5
+            ) as r:
+                print(r.read().decode())
+        except OSError as e:
+            print(f"telemetry fetch FAILED: {e}")
+        return
+    # no URL: dump this process's own registry/trace/audit state
+    d = telemetry.dump()
+    n_spans = len(d["trace"]["traceEvents"])
+    print(f"local trace buffer: {n_spans} events; "
+          f"audit records: {len(d['audit'])}")
+    if d["metrics"].strip():
+        print(d["metrics"])
+
+
 def main(argv) -> None:
     _show_versions()
     if "--no-devices" not in argv:
         _show_devices()
     _show_cluster_env()
+    if "--telemetry" in argv:
+        _show_telemetry(argv)
     allowed = (
         len(os.sched_getaffinity(0))
         if hasattr(os, "sched_getaffinity")  # Linux-only

@@ -235,7 +235,7 @@ def make_ring_transformer_loss(cfg: TransformerConfig, mesh,
     """Sequence-parallel causal-LM loss: batch = (tokens, targets), both
     (B, S) with B divisible by dp and S by sp. Returns loss_fn(params,
     batch) -> replicated scalar, jit/grad-compatible (shard_map inside)."""
-    from jax import shard_map
+    from kungfu_tpu.parallel._compat import shard_map
 
     sp_size = mesh.shape[sp_axis]
 

@@ -90,3 +90,17 @@ def gradient_variance(opt_state) -> jnp.ndarray:
     """Read the latest variance estimate out of a monitored optimizer
     state."""
     return opt_state.grad_var.variance
+
+
+def publish_gradient_variance(opt_state) -> float:
+    """Pull the variance estimate to the host and publish it as the
+    ``kungfu_gradient_variance`` gauge; returns the value. Call at a
+    logging cadence — this is an explicit device -> host transfer."""
+    from kungfu_tpu.telemetry import metrics as _tm
+
+    val = float(gradient_variance(opt_state))
+    _tm.gauge(
+        "kungfu_gradient_variance",
+        "Cross-worker gradient variance (summed Frobenius norm)",
+    ).set(val)
+    return val

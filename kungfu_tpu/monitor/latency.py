@@ -18,6 +18,23 @@ def probe_peer_latencies(client, peers, self_rank: int, samples: int = 3) -> np.
     """RTT seconds per peer, aligned to rank order; self = 0.0, unreachable
     peers = +inf. Takes the best of `samples` probes (min filters out
     scheduler noise, the standard RTT-probe practice)."""
+    from kungfu_tpu.telemetry import config as _tcfg
+    from kungfu_tpu.telemetry import metrics as _tm
+
+    rtt_gauge = (
+        _tm.gauge(
+            "kungfu_peer_rtt_seconds",
+            "Best probed RTT per peer (+inf peers omitted)",
+            ("peer",),
+        )
+        if _tcfg.metrics_enabled()
+        else None
+    )
+    if rtt_gauge is not None:
+        # each probe covers the CURRENT cluster: dropping the old children
+        # first stops departed peers from reporting stale RTTs forever and
+        # bounds label cardinality across elastic resizes
+        rtt_gauge.clear_children()
     out = np.zeros(len(peers), np.float64)
     for r, peer in enumerate(peers):
         if r == self_rank:
@@ -28,6 +45,8 @@ def probe_peer_latencies(client, peers, self_rank: int, samples: int = 3) -> np.
             if client.ping(peer, timeout=2.0):
                 best = min(best, time.perf_counter() - t0)
         out[r] = best
+        if rtt_gauge is not None and np.isfinite(best):
+            rtt_gauge.labels(str(peer)).set(best)
     return out
 
 

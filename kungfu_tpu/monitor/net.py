@@ -1,27 +1,37 @@
 """Network monitor: per-peer egress/ingress byte counters + rate windows.
 
 Capability parity: srcs/go/monitor/{monitor,counters,server}.go — totals
-and windowed rates per peer, Prometheus-style text endpoint, enabled by
-KF_CONFIG_ENABLE_MONITORING; surfaced to training as egress_rates()
+and windowed rates per peer, surfaced to training as egress_rates()
 (parity: ops/cpu/monitoring.cpp:5-22 + session monitoring).
+
+Refactored onto the shared telemetry subsystem (ISSUE 1): the singleton
+monitor mirrors every count into the process metrics registry
+(``kungfu_egress_bytes_total``/``kungfu_ingress_bytes_total`` and
+message counters, labelled by peer), and the Prometheus endpoint is the
+per-worker TelemetryServer (``/metrics`` + ``/trace`` + ``/audit``) —
+the bespoke /metrics-only server this module used to own survives as a
+thin back-compat wrapper. Enabled by ``KF_CONFIG_ENABLE_MONITORING``
+(any truthy spelling: 1/true/yes/on) or ``KF_TELEMETRY=metrics``.
 """
 
 from __future__ import annotations
 
-import os
 import threading
 import time
 from collections import defaultdict, deque
-from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Dict, List, Optional, Tuple
+from typing import Dict, List, Optional
 
 from kungfu_tpu.plan.peer import PeerID
+from kungfu_tpu.telemetry import config as _tconfig
+from kungfu_tpu.telemetry import metrics as _metrics
 
 DEFAULT_WINDOW = 1.0  # seconds
 
 
 def enabled() -> bool:
-    return os.environ.get("KF_CONFIG_ENABLE_MONITORING", "") in ("1", "true")
+    """Truthy parsing is shared (telemetry.config.truthy): "yes"/"on"
+    variants used to be silently rejected here."""
+    return _tconfig.metrics_enabled()
 
 
 class RateCounter:
@@ -59,41 +69,106 @@ class RateCounter:
 
 
 class NetMonitor:
-    def __init__(self):
+    def __init__(self, registry: Optional[_metrics.Registry] = None):
+        # guards the peer->counter TABLES (key inserts vs. scrape
+        # iteration); each RateCounter still has its own lock for adds
+        self._tables_lock = threading.Lock()
         self._egress: Dict[PeerID, RateCounter] = defaultdict(RateCounter)
         self._ingress: Dict[PeerID, RateCounter] = defaultdict(RateCounter)
+        # registry mirroring: only the process singleton (get_monitor)
+        # publishes into the shared registry; standalone instances (tests)
+        # stay self-contained. Per-peer label children are cached beside
+        # the rate counters (_children) — sent()/received() run per
+        # MESSAGE, so the steady path must be cached-object .inc() calls,
+        # not str(peer) + family-lock label lookups
+        self._registry = registry
+        self._reg_children: Dict[PeerID, tuple] = {}
+        if registry is not None:
+            self._reg_families = tuple(
+                registry.counter(name, help, ("peer",))
+                for name, help in (
+                    ("kungfu_egress_bytes_total",
+                     "Bytes sent per peer over the host transport"),
+                    ("kungfu_ingress_bytes_total",
+                     "Bytes received per peer over the host transport"),
+                    ("kungfu_egress_messages_total",
+                     "Messages sent per peer over the host transport"),
+                    ("kungfu_ingress_messages_total",
+                     "Messages received per peer over the host transport"),
+                )
+            )
+            registry.add_renderer(self.render_rates)
+        else:
+            self._reg_families = None
+
+    def _counter(self, table: Dict[PeerID, RateCounter], peer: PeerID) -> RateCounter:
+        # insert under the tables lock so a concurrent scrape's snapshot
+        # never races a rehash (first message from a new peer mid-resize)
+        with self._tables_lock:
+            return table[peer]
+
+    def _children(self, peer: PeerID) -> tuple:
+        kids = self._reg_children.get(peer)
+        if kids is None:
+            label = str(peer)
+            kids = tuple(f.labels(label) for f in self._reg_families)
+            with self._tables_lock:
+                kids = self._reg_children.setdefault(peer, kids)
+        return kids
+
+    def _snapshot(self, table):
+        with self._tables_lock:
+            return sorted(table.items(), key=lambda kv: str(kv[0]))
 
     def sent(self, peer: PeerID, n: int) -> None:
-        self._egress[peer].add(n)
+        self._counter(self._egress, peer).add(n)
+        if self._reg_families is not None:
+            ebytes, _, emsgs, _ = self._children(peer)
+            ebytes.inc(n)
+            emsgs.inc()
 
     def received(self, peer: PeerID, n: int) -> None:
-        self._ingress[peer].add(n)
+        self._counter(self._ingress, peer).add(n)
+        if self._reg_families is not None:
+            _, ibytes, _, imsgs = self._children(peer)
+            ibytes.inc(n)
+            imsgs.inc()
 
     def egress_totals(self) -> Dict[PeerID, int]:
-        return {p: c.total for p, c in self._egress.items()}
+        return {p: c.total for p, c in self._snapshot(self._egress)}
 
     def egress_rates(self, peers: List[PeerID]) -> List[float]:
         """Rates aligned to a rank order (parity: GetEgressRates)."""
-        return [self._egress[p].rate() if p in self._egress else 0.0 for p in peers]
+        with self._tables_lock:
+            table = dict(self._egress)
+        return [table[p].rate() if p in table else 0.0 for p in peers]
 
     def ingress_rates(self, peers: List[PeerID]) -> List[float]:
-        return [self._ingress[p].rate() if p in self._ingress else 0.0 for p in peers]
+        with self._tables_lock:
+            table = dict(self._ingress)
+        return [table[p].rate() if p in table else 0.0 for p in peers]
+
+    def render_rates(self) -> str:
+        """Windowed-rate gauges (not plain registry samples: the window is
+        computed at scrape time)."""
+        lines = []
+        for name, table in (("egress", self._egress), ("ingress", self._ingress)):
+            lines.append(f"# TYPE kungfu_{name}_rate gauge")
+            for p, c in self._snapshot(table):
+                lines.append(f'kungfu_{name}_rate{{peer="{p}"}} {c.rate():.1f}')
+        return "\n".join(lines) + "\n"
 
     def render_metrics(self) -> str:
-        """Prometheus-style exposition (parity: monitor/server.go)."""
+        """Prometheus-style exposition (parity: monitor/server.go):
+        byte totals plus the rate block shared with render_rates()."""
         lines = []
         for name, table in (("egress", self._egress), ("ingress", self._ingress)):
             lines.append(f"# TYPE kungfu_{name}_bytes counter")
-            for p, c in sorted(table.items(), key=lambda kv: str(kv[0])):
+            for p, c in self._snapshot(table):
                 lines.append(
                     f'kungfu_{name}_bytes{{peer="{p}"}} {c.total}'
                 )
-            lines.append(f"# TYPE kungfu_{name}_rate gauge")
-            for p, c in sorted(table.items(), key=lambda kv: str(kv[0])):
-                lines.append(
-                    f'kungfu_{name}_rate{{peer="{p}"}} {c.rate():.1f}'
-                )
-        return "\n".join(lines) + "\n"
+        return "\n".join(lines) + "\n" + self.render_rates()
 
 
 _global_monitor: Optional[NetMonitor] = None
@@ -104,35 +179,41 @@ def get_monitor() -> NetMonitor:
     global _global_monitor
     with _monitor_lock:
         if _global_monitor is None:
-            _global_monitor = NetMonitor()
+            _global_monitor = NetMonitor(registry=_metrics.get_registry())
         return _global_monitor
 
 
 class MetricsServer:
-    """/metrics HTTP endpoint (parity: peer's port+10000 server)."""
+    """Back-compat /metrics endpoint for a standalone NetMonitor.
+
+    Workers under a Peer get the full TelemetryServer (/metrics + /trace
+    + /audit) instead; this wrapper keeps the old ``MetricsServer(mon,
+    port)`` contract for embedders and serves the monitor's own
+    exposition alongside the process registry.
+    """
 
     def __init__(self, monitor: NetMonitor, port: int):
-        class Handler(BaseHTTPRequestHandler):
-            def log_message(self, *a):
-                pass
+        from kungfu_tpu.telemetry.http import TelemetryServer
 
-            def do_GET(inner):
-                if inner.path.rstrip("/") != "/metrics":
-                    inner.send_response(404)
-                    inner.end_headers()
-                    return
-                body = monitor.render_metrics().encode()
-                inner.send_response(200)
-                inner.send_header("Content-Type", "text/plain")
-                inner.send_header("Content-Length", str(len(body)))
-                inner.end_headers()
-                inner.wfile.write(body)
-
-        self.httpd = ThreadingHTTPServer(("0.0.0.0", port), Handler)
-        self.port = self.httpd.server_address[1]
+        reg = _metrics.get_registry()
+        self._srv = TelemetryServer(
+            port,
+            extra_routes={
+                # include_extras=False: render_metrics() already carries
+                # this monitor's rate gauges, and when `monitor` is the
+                # process singleton its renderer is ALSO attached to the
+                # registry — emitting a family twice is invalid exposition
+                "/metrics": lambda: (
+                    monitor.render_metrics() + reg.render(include_extras=False),
+                    "text/plain; version=0.0.4",
+                )
+            },
+        )
+        self.port = self._srv.port
+        self.httpd = self._srv.httpd
 
     def start(self):
-        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+        self._srv.start()
 
     def stop(self):
-        self.httpd.shutdown()
+        self._srv.stop()

@@ -90,6 +90,29 @@ def noise_scale(state: GNSState) -> jnp.ndarray:
     )
 
 
+def publish_noise_scale(state: GNSState) -> float:
+    """Pull the GNS estimate to the host and publish it as the
+    ``kungfu_noise_scale`` gauge (plus the raw EMAs); returns the value.
+
+    The estimate itself stays on-device in the optimizer state — call
+    this at a logging cadence, not per step (it is an explicit device ->
+    host transfer, the thing the compiled estimator avoids)."""
+    from kungfu_tpu.telemetry import metrics as _tm
+
+    val = float(noise_scale(state))
+    _tm.gauge(
+        "kungfu_noise_scale",
+        "Gradient noise scale (McCandlish critical batch estimate)",
+    ).set(val)
+    _tm.gauge(
+        "kungfu_noise_scale_g2_ema", "EMA of the |G|^2 estimate"
+    ).set(float(state.g2_ema))
+    _tm.gauge(
+        "kungfu_noise_scale_s_ema", "EMA of the tr(S) estimate"
+    ).set(float(state.s_ema))
+    return val
+
+
 class _MonitorState(NamedTuple):
     base: optax.OptState
     gns: GNSState

@@ -160,7 +160,7 @@ def make_hier_train_step(
     mesh (ICI), then averaged across worlds on the host plane, then the
     optax update applies identically in every world.
     """
-    from jax import shard_map
+    from kungfu_tpu.parallel._compat import shard_map
 
     reducer = CrossSliceReducer(peer=peer, name=name, compress=compress)
     bspec = batch_spec if batch_spec is not None else P(axis_name)

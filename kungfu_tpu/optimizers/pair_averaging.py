@@ -70,6 +70,23 @@ class PairAveraging:
         # mid-request gets a consistent snapshot while we publish the next
         # (parity: p2p.go versioned requests)
         self._version = 0
+        # pair-exchange hit rate: a falling "avg" share means peers are
+        # stale/mid-resize and steps degrade to plain local SGD. Label
+        # children cached here — step() is the training hot path
+        self._m_steps = None
+        from kungfu_tpu.telemetry import config as _tcfg
+
+        if _tcfg.metrics_enabled():
+            from kungfu_tpu.telemetry import metrics as _tm
+
+            fam = _tm.counter(
+                "kungfu_pair_avg_steps_total",
+                "PairAveraging steps by exchange outcome",
+                ("outcome",),
+            )
+            self._m_steps = {
+                "avg": fam.labels("avg"), "plain": fam.labels("plain")
+            }
 
     # -- jitted compute ------------------------------------------------
     def _build(self, params):
@@ -167,6 +184,8 @@ class PairAveraging:
                 other_blob = self._fetched[0]
             self._prefetch = None
         other = self._unpack_other(other_blob) if other_blob else None
+        if self._m_steps is not None:
+            self._m_steps["avg" if other is not None else "plain"].inc()
         if other is not None:
             params, opt_state = self._step_fns["avg"](
                 params, other, grads, opt_state

@@ -1,0 +1,32 @@
+"""JAX version compatibility for shard_map.
+
+The API moved twice across the jax versions this repo meets in the
+wild: `jax.experimental.shard_map.shard_map` -> `jax.shard_map`, and
+its replication-check kwarg renamed `check_rep` -> `check_vma`. Every
+call site imports this wrapper (newer-jax calling convention) so the
+package works on both.
+"""
+
+from __future__ import annotations
+
+import inspect
+
+try:
+    from jax import shard_map as _shard_map  # jax >= 0.6
+except ImportError:  # older jax: experimental namespace
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+_params = set(inspect.signature(_shard_map).parameters)
+if "check_vma" in _params:
+    _CHECK_KW = "check_vma"
+elif "check_rep" in _params:
+    _CHECK_KW = "check_rep"
+else:
+    _CHECK_KW = None
+
+
+def shard_map(f, *, mesh, in_specs, out_specs, check_vma: bool = False):
+    kw = {_CHECK_KW: check_vma} if _CHECK_KW else {}
+    return _shard_map(
+        f, mesh=mesh, in_specs=in_specs, out_specs=out_specs, **kw
+    )

@@ -19,7 +19,7 @@ from typing import Callable, Optional, Tuple
 
 import jax
 import optax
-from jax import shard_map
+from kungfu_tpu.parallel._compat import shard_map
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 

@@ -24,7 +24,7 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
-from jax import shard_map
+from kungfu_tpu.parallel._compat import shard_map
 
 
 def make_mesh(shape: Optional[Dict[str, int]] = None, *, devices=None) -> Mesh:

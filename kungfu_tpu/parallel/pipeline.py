@@ -105,7 +105,7 @@ def make_pp_transformer_loss(cfg, mesh, n_micro: int, pp_axis: str = "pp",
             loss = lax.pmean(loss, dp_axis)
         return loss
 
-    from jax import shard_map
+    from kungfu_tpu.parallel._compat import shard_map
 
     batch_spec = P(dp_axis) if dp_axis is not None else P()
     param_specs = {

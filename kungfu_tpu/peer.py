@@ -111,30 +111,37 @@ class Peer:
         if not self.config.single_process:
             with trace.span("worker.start.server"):
                 self.server.start()
-        self._start_metrics_server()
+        self._start_telemetry_server()
         with trace.span("worker.start.update"):
             self._update_to(self._peers)
 
-    def _start_metrics_server(self) -> None:
-        """Expose /metrics on self.port+10000 when monitoring is on
-        (parity: peer/peer.go:96-104)."""
+    def _start_telemetry_server(self) -> None:
+        """Expose /metrics + /trace + /audit on self.port+10000 when any
+        telemetry is on (parity: peer/peer.go:96-104, generalized from the
+        old /metrics-only server in monitor/net.py)."""
         self.metrics_server = None
+        from kungfu_tpu import telemetry
         from kungfu_tpu.monitor import net as _net
 
-        if _net.enabled() and not self.config.single_process:
+        want = _net.enabled() or telemetry.features()
+        if want and not self.config.single_process:
+            # materialize the singleton so transport counters mirror into
+            # the registry this server renders
+            _net.get_monitor()
             try:
-                self.metrics_server = _net.MetricsServer(
-                    _net.get_monitor(), self.self_id.port + 10000
-                )
+                from kungfu_tpu.telemetry.http import TelemetryServer
+
+                self.metrics_server = TelemetryServer(self.self_id.port + 10000)
                 self.metrics_server.start()
             except (OSError, OverflowError) as e:
                 # OverflowError: peer port within 10000 of 65535
-                log.warn("metrics server failed to start: %s", e)
+                log.warn("telemetry server failed to start: %s", e)
 
     def stop(self) -> None:
         self.server.stop()
         self.client.close()
         if getattr(self, "metrics_server", None) is not None:
+            # clean shutdown on peer exit: close the listening socket too
             self.metrics_server.stop()
 
     # ------------------------------------------------------------------
@@ -201,12 +208,20 @@ class Peer:
             self.client.send(runner, "update", payload, ConnType.CONTROL)
             log.debug("notified runner %s", runner)
 
-    def _propose(self, cluster: Cluster, progress: int = 0) -> Tuple[bool, bool]:
+    def _propose(
+        self,
+        cluster: Cluster,
+        progress: int = 0,
+        trigger: str = "explicit",
+        pre_phases: Optional[dict] = None,
+    ) -> Tuple[bool, bool]:
         """Consensus-check and adopt a new cluster.
 
         Returns (accepted, keep): keep=False means self is detached.
         Parity: peer.propose (peer.go:181-233) including the safety check —
         peers must agree on the proposed bytes or the resize is rejected.
+        `trigger` and `pre_phases` (e.g. the config-server wait) feed the
+        telemetry resize audit record.
         """
         sess = self.current_session()
         t0 = time.perf_counter()
@@ -218,9 +233,11 @@ class Peer:
             return False, True
         if self._peers == cluster.workers:
             return True, True  # no change
-        self.last_resize_phases = {
-            "consensus_ms": round((time.perf_counter() - t0) * 1e3, 1)
-        }
+        old_peers = self._peers
+        self.last_resize_phases = dict(pre_phases or {})
+        self.last_resize_phases["consensus_ms"] = round(
+            (time.perf_counter() - t0) * 1e3, 1
+        )
         stage = {
             "Version": self.cluster_version + 1,
             "Progress": progress,
@@ -240,6 +257,26 @@ class Peer:
             keep = self._update_to(cluster.workers)
         self.last_resize_phases["update_ms"] = round(
             (time.perf_counter() - t2) * 1e3, 1
+        )
+        from kungfu_tpu.telemetry import audit as _audit
+
+        _audit.record_resize(
+            peer=str(self.self_id),
+            cluster_version=self.cluster_version,
+            trigger=trigger,
+            old_peers=list(old_peers),
+            new_peers=list(cluster.workers),
+            phases_ms=self.last_resize_phases,
+            progress=progress or None,
+            detached=not keep,
+        )
+        log.info(
+            "resize v%d: %d -> %d workers (%s)%s",
+            self.cluster_version,
+            len(old_peers),
+            len(cluster.workers),
+            trigger,
+            "" if keep else " [detached]",
         )
         return True, keep
 
@@ -283,12 +320,13 @@ class Peer:
         wait_ms = round((time.perf_counter() - t0) * 1e3, 1)
         if cluster.workers == self._peers:
             return False, False
-        accepted, keep = self._propose(cluster)
-        if accepted:
-            # only stamp onto the record _propose just rebuilt; a rejected
-            # proposal must not splice this wait into the PREVIOUS
-            # resize's phase breakdown
-            self.last_resize_phases["wait_config_ms"] = wait_ms
+        # pre_phases rides into _propose so a REJECTED proposal never
+        # splices this wait into the previous resize's phase breakdown
+        accepted, keep = self._propose(
+            cluster,
+            trigger="config_server",
+            pre_phases={"wait_config_ms": wait_ms},
+        )
         return accepted, not keep
 
     def resize_cluster(self, new_size: int) -> Tuple[bool, bool]:
@@ -297,7 +335,7 @@ class Peer:
         cluster = current.resize(new_size)
         if cluster.workers == self._peers:
             return False, False
-        accepted, keep = self._propose(cluster)
+        accepted, keep = self._propose(cluster, trigger="explicit")
         return accepted, not keep
 
     def propose_new_size(self, new_size: int) -> None:
@@ -334,6 +372,17 @@ class Peer:
         }
         if sess.rank == 0 and self.config.runners:
             self._notify_runners(stage)
+        from kungfu_tpu.telemetry import audit as _audit
+
+        _audit.record_resize(
+            peer=str(self.self_id),
+            cluster_version=self.cluster_version + 1,
+            trigger="reload",
+            old_peers=list(self._peers),
+            new_peers=list(cluster.workers),
+            progress=progress,
+            detached=True,
+        )
         # in reload mode every worker detaches; runners restart the world
         self.detached = True
         return True, True

@@ -28,6 +28,7 @@ import threading
 from typing import List, Optional
 
 from kungfu_tpu.plan.hostspec import HostList, parse_hostfile
+from kungfu_tpu.telemetry import log
 
 DEFAULT_SSH = "ssh -o StrictHostKeyChecking=no -o BatchMode=yes"
 
@@ -115,7 +116,7 @@ def main(argv: Optional[List[str]] = None) -> int:
     if cmd and cmd[0] == "--":
         cmd = cmd[1:]
     if not cmd:
-        print("kf-distribute: no command given", file=sys.stderr)
+        log.error("kf-distribute: no command given")
         return 2
     try:
         if args.hostfile:
@@ -126,7 +127,7 @@ def main(argv: Optional[List[str]] = None) -> int:
         else:
             raise ValueError("one of -H / -hostfile is required")
     except (ValueError, OSError) as e:
-        print(f"kf-distribute: {e}", file=sys.stderr)
+        log.error("kf-distribute: %s", e)
         return 2
 
     procs = [
@@ -141,10 +142,7 @@ def main(argv: Optional[List[str]] = None) -> int:
             stop.set()
             live = [p for p in procs if p.proc and p.proc.poll() is None]
             if live:
-                print(
-                    f"kf-distribute: tearing down {len(live)} hosts",
-                    file=sys.stderr,
-                )
+                log.warn("kf-distribute: tearing down %d hosts", len(live))
             for p in live:
                 p.kill()
 
@@ -167,7 +165,7 @@ def main(argv: Optional[List[str]] = None) -> int:
                 return 130
         bad = [(p.host, c) for p, c in zip(procs, codes) if c != 0]
         if bad:
-            print(f"kf-distribute: failed on {bad}", file=sys.stderr)
+            log.error("kf-distribute: failed on %s", bad)
             return 1
         return 0
     finally:

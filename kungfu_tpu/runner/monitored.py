@@ -36,6 +36,8 @@ import urllib.request
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Dict, List, Optional, Tuple
 
+from kungfu_tpu.telemetry import log
+
 MONITOR_PORT = 7756
 DEFAULT_GRACE = 10.0
 MONITOR_ADDR_ENV = "KF_MONITOR_ADDR"
@@ -209,17 +211,14 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
                     if all(c == 0 for c in codes):
                         return 0
                     failed = True
-                    print(
-                        f"kfrun: workers exited {codes}; restarting",
-                        file=sys.stderr,
-                    )
+                    log.warn("kfrun: workers exited %s; restarting", codes)
                     recover_epoch = state.min_epoch(n_local)
                     break
                 if state.stuck_ranks(grace):
                     recover_epoch = state.min_epoch(n_local)
-                    print(
-                        f"kfrun: worker stuck > {grace}s at epoch {recover_epoch}; restarting",
-                        file=sys.stderr,
+                    log.warn(
+                        "kfrun: worker stuck > %ss at epoch %s; restarting",
+                        grace, recover_epoch,
                     )
                     failed = True
                     local_down = True
@@ -229,9 +228,9 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
                     # every host must resume from the cluster-wide min, not
                     # its own (a fast host would otherwise skip ahead)
                     recover_epoch = min(state.min_epoch(n_local), state.other_down)
-                    print(
-                        f"kfrun: otherdown:{state.other_down} received; restarting",
-                        file=sys.stderr,
+                    log.warn(
+                        "kfrun: otherdown:%s received; restarting",
+                        state.other_down,
                     )
                     failed = True
                     break
@@ -256,9 +255,9 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
                         return 0
                     failed = True
                     recover_epoch = state.min_epoch(n_local)
-                    print(
-                        f"kfrun: workers exited {codes} after trainend; restarting",
-                        file=sys.stderr,
+                    log.warn(
+                        "kfrun: workers exited %s after trainend; restarting",
+                        codes,
                     )
                     break
                 time.sleep(0.25)
@@ -275,7 +274,7 @@ def monitored_run(args, cmd, cluster, self_host: str, strategy) -> int:
                 return 0
             restart += 1
             if restart > 100:
-                print("kfrun: too many restarts, giving up", file=sys.stderr)
+                log.error("kfrun: too many restarts, giving up")
                 return 1
     finally:
         monitor.stop()

@@ -13,6 +13,8 @@ import sys
 import threading
 from typing import Dict, List, Optional
 
+from kungfu_tpu.telemetry import log
+
 _COLORS = [31, 32, 33, 34, 35, 36, 91, 92, 93, 94, 95, 96]
 
 # Orphan protection: children get SIGTERM when the runner dies
@@ -37,10 +39,9 @@ def _shim_argv(argv: List[str]) -> List[str]:
     global _warned_no_shim
     if not _warned_no_shim and os.name == "posix":
         _warned_no_shim = True
-        print(
+        log.warn(
             "kfrun: kf-pdeathsig shim not built (native/build.sh); workers "
-            "will not be reaped if this runner is hard-killed",
-            file=sys.stderr,
+            "will not be reaped if this runner is hard-killed"
         )
     return list(argv)
 
@@ -101,10 +102,10 @@ class WorkerProc:
             global _shim_broken
             if not _shim_broken:
                 _shim_broken = True
-                print(
-                    f"kfrun: kf-pdeathsig unusable ({e}); spawning workers "
+                log.warn(
+                    "kfrun: kf-pdeathsig unusable (%s); spawning workers "
                     "WITHOUT orphan protection (rebuild via native/build.sh)",
-                    file=sys.stderr,
+                    e,
                 )
             self.proc = subprocess.Popen(
                 list(self.argv),
@@ -118,10 +119,7 @@ class WorkerProc:
             from kungfu_tpu.runner.affinity import apply_affinity
 
             if apply_affinity(self.proc.pid, self.cpus) and not self.quiet:
-                print(
-                    f"[{self.name}] pinned to cpus {self.cpus}",
-                    file=sys.stderr,
-                )
+                log.info("[%s] pinned to cpus %s", self.name, self.cpus)
         logfile = None
         if self.logdir:
             os.makedirs(self.logdir, exist_ok=True)

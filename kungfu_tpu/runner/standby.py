@@ -222,7 +222,9 @@ def main() -> None:
     _die_with_parent()
     fifo = os.environ.get("KF_STANDBY_FIFO", "")
     if not fifo:
-        print("kf-standby: KF_STANDBY_FIFO not set", file=sys.stderr)
+        from kungfu_tpu.telemetry import log
+
+        log.error("kf-standby: KF_STANDBY_FIFO not set")
         sys.exit(2)
     # open for reading BEFORE warming so the watcher's nonblocking
     # open-for-write succeeds from the moment we exist
@@ -230,13 +232,16 @@ def main() -> None:
         fd = os.open(fifo, os.O_RDONLY | os.O_NONBLOCK)
     except FileNotFoundError:
         # the pool already swept this slot (watcher teardown raced us)
-        print("kf-standby: fifo gone before open; exiting", file=sys.stderr)
+        from kungfu_tpu.telemetry import log
+
+        log.warn("kf-standby: fifo gone before open; exiting")
         sys.exit(0)
     # warm imports: the bulk of cold-join latency
     import numpy  # noqa: F401
 
     import kungfu_tpu.api  # noqa: F401
     import kungfu_tpu.monitor.net  # noqa: F401  (Peer.__init__ pulls it)
+    from kungfu_tpu.telemetry import log as _log
 
     # "auto"/"none" are resolved by the POOL (resolve_preload); an unset
     # or empty env means no extra preloads — "" must stay a working
@@ -245,8 +250,8 @@ def main() -> None:
         try:
             __import__(mod)
         except ImportError as e:
-            print(f"kf-standby: preload {mod} failed: {e}", file=sys.stderr)
-    print("kf-standby: warm", flush=True)
+            _log.warn("kf-standby: preload %s failed: %s", mod, e)
+    _log.echo("kf-standby: warm")
     # block until the activation line arrives
     import select
 

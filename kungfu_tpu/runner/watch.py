@@ -13,12 +13,12 @@ from __future__ import annotations
 import collections
 import json
 import queue
-import sys
 import threading
 import time
 from typing import Dict, List, Optional
 
 from kungfu_tpu.plan.cluster import Cluster
+from kungfu_tpu.telemetry import log
 from kungfu_tpu.plan.peer import PeerID, PeerList
 from kungfu_tpu.runner.proc import WorkerProc
 from kungfu_tpu.transport.message import ConnType, Message
@@ -191,9 +191,9 @@ class Watcher:
         if stage.version in self.seen_versions:
             if self.seen_versions[stage.version] != digest:
                 # diverged proposals for the same version: unrecoverable
-                print(
-                    f"kfrun: inconsistent cluster for version {stage.version}; aborting",
-                    file=sys.stderr,
+                log.error(
+                    "kfrun: inconsistent cluster for version %s; aborting",
+                    stage.version,
                 )
                 self.exit_code = 1
                 self.done.set()
@@ -216,7 +216,7 @@ class Watcher:
                 # a growing host exceeding its chip budget must not crash
                 # the runner mid-resize: spawn unpinned and say so (the
                 # upfront cli check makes this unreachable for valid plans)
-                print(f"kfrun: {e}; spawning {w} unpinned", file=sys.stderr)
+                log.warn("kfrun: %s; spawning %s unpinned", e, w)
                 slots = None
         p = make_one_worker_proc(
             self.args, self.cmd, stage.cluster, w, self.self_host, self.strategy,
@@ -240,17 +240,19 @@ class Watcher:
             if slot is not None:
                 _t_act0 = time.monotonic()
                 if slot.activate(p.env, p.argv, p.name, p.rank):
-                    print(f"kfrun: warm standby activated as {p.name}"
-                          f" (prep {(_t_act0 - _t_spawn0) * 1e3:.1f} ms,"
-                          f" activate {(time.monotonic() - _t_act0) * 1e3:.1f} ms)",
-                          file=sys.stderr)
+                    log.info(
+                        "kfrun: warm standby activated as %s"
+                        " (prep %.1f ms, activate %.1f ms)",
+                        p.name,
+                        (_t_act0 - _t_spawn0) * 1e3,
+                        (time.monotonic() - _t_act0) * 1e3,
+                    )
                     with self._state_lock:
                         self.current[w] = slot.proc
                     return
                 # unreachable fifo: the standby is dead or wedged — never
                 # reusable, don't leak it
-                print(f"kfrun: standby unreachable; cold spawning {p.name}",
-                      file=sys.stderr)
+                log.warn("kfrun: standby unreachable; cold spawning %s", p.name)
                 slot.proc.kill()
         p.start()
         with self._state_lock:
@@ -318,7 +320,7 @@ class Watcher:
             with urllib.request.urlopen(req, timeout=5) as resp:
                 resp.read()
         except OSError as e:
-            print(f"kfrun: config-server PUT failed: {e}", file=sys.stderr)
+            log.warn("kfrun: config-server PUT failed: %s", e)
 
     def recover_from_failure(self, dead: List[PeerID]) -> None:
         """Shrink the dead workers out and reload the survivors from the
@@ -328,7 +330,7 @@ class Watcher:
         corpses back in."""
         self.failure_restarts += 1
         if self.failure_restarts > 10:
-            print("kfrun: too many failure recoveries, giving up", file=sys.stderr)
+            log.error("kfrun: too many failure recoveries, giving up")
             self.exit_code = 1
             self.done.set()
             return
@@ -338,9 +340,8 @@ class Watcher:
             str(w): (self.current[w].proc.returncode if w in self.current else "?")
             for w in dead
         }
-        print(
-            f"kfrun: workers {codes} died; reloading at size {len(survivors)}",
-            file=sys.stderr,
+        log.warn(
+            "kfrun: workers %s died; reloading at size %d", codes, len(survivors)
         )
         if not survivors:
             self.exit_code = 1
@@ -392,7 +393,7 @@ class Watcher:
                 try:
                     cl.send(r, "update", payload, ConnType.CONTROL)
                 except (ConnectionError, OSError) as e:
-                    print(f"kfrun: notify {r} failed: {e}", file=sys.stderr)
+                    log.warn("kfrun: notify %s failed: %s", r, e)
             cl.close()
         self.apply_full(stage)
 
@@ -404,7 +405,7 @@ class Watcher:
         if getattr(self.args, "debug_port", -1) >= 0:
             debug = DebugServer(self, self.args.debug_port)
             debug.start()
-            print(f"kfrun: debug endpoint on :{debug.port}", file=sys.stderr)
+            log.info("kfrun: debug endpoint on :%d", debug.port)
         idle_since: Optional[float] = None
         try:
             self.apply_delta(initial)
@@ -438,10 +439,9 @@ class Watcher:
                                     with self._state_lock:
                                         proc = self.current.get(w)
                                     if proc is not None:
-                                        print(
-                                            f"kfrun: worker {w} stuck > "
-                                            f"{self.grace}s; killing",
-                                            file=sys.stderr,
+                                        log.warn(
+                                            "kfrun: worker %s stuck > %ss; killing",
+                                            w, self.grace,
                                         )
                                         proc.kill()
                                         dead.append(w)

@@ -1,0 +1,82 @@
+"""kungfu_tpu.telemetry — unified observability for the host plane.
+
+One subsystem, three surfaces (ISSUE 1 tentpole):
+
+- :mod:`~kungfu_tpu.telemetry.metrics` — process-wide registry of
+  counters/gauges/histograms with labels, Prometheus text exposition;
+- :mod:`~kungfu_tpu.telemetry.tracing` — span tracing (ring buffer,
+  nesting, Chrome-trace/Perfetto JSON export);
+- :mod:`~kungfu_tpu.telemetry.audit` — structured resize/strategy audit
+  log for every elastic membership change.
+
+Plus :mod:`~kungfu_tpu.telemetry.log` (structured rank-prefixed logger,
+the repo-wide replacement for bare ``print()``) and
+:mod:`~kungfu_tpu.telemetry.http` (the per-worker ``/metrics`` +
+``/trace`` + ``/audit`` endpoint).
+
+Feature selection: ``KF_TELEMETRY=metrics,trace`` (see
+:mod:`~kungfu_tpu.telemetry.config`). ``dump()`` snapshots everything
+for ad-hoc inspection; see docs/telemetry.md for naming conventions.
+"""
+
+from __future__ import annotations
+
+from kungfu_tpu.telemetry import audit, config, log, metrics, tracing
+from kungfu_tpu.telemetry.config import (
+    enable,
+    enabled,
+    env_truthy,
+    features,
+    metrics_enabled,
+    refresh,
+    trace_enabled,
+    truthy,
+)
+from kungfu_tpu.telemetry.metrics import get_registry
+
+__all__ = [
+    "audit",
+    "config",
+    "log",
+    "metrics",
+    "tracing",
+    "enable",
+    "enabled",
+    "env_truthy",
+    "features",
+    "metrics_enabled",
+    "refresh",
+    "trace_enabled",
+    "truthy",
+    "get_registry",
+    "dump",
+    "serve",
+]
+
+
+def dump(prefix: str = "") -> dict:
+    """Snapshot every telemetry surface of this process:
+
+    ``metrics``  Prometheus text exposition,
+    ``trace``    Chrome-trace JSON object (``traceEvents`` with
+                 ``ph``/``ts``/``dur``),
+    ``audit``    resize/strategy audit records as dicts,
+    ``spans``    total-ms-per-span summary (quick look).
+    """
+    return {
+        "features": sorted(features()),
+        "metrics": metrics.render(),
+        "trace": tracing.chrome_trace(prefix),
+        "audit": audit.to_json(),
+        "spans": tracing.summary_ms(prefix),
+    }
+
+
+def serve(port: int = 0, host: str = "0.0.0.0"):
+    """Start a standalone telemetry endpoint (started+returned); workers
+    under a Peer get one automatically on peer_port+10000."""
+    from kungfu_tpu.telemetry.http import TelemetryServer
+
+    srv = TelemetryServer(port, host=host)
+    srv.start()
+    return srv

@@ -1,0 +1,93 @@
+"""Per-worker telemetry HTTP endpoint: /metrics + /trace + /audit.
+
+One server per worker replaces the bespoke /metrics-only server that
+used to live in monitor/net.py (parity: the reference peer's
+port+10000 monitoring server, srcs/go/monitor/server.go — extended to
+serve the whole telemetry subsystem):
+
+- ``/metrics``  Prometheus text exposition of the process registry
+  (plus attached renderers, e.g. the net monitor's windowed rates);
+- ``/trace``    Chrome-trace JSON of the span ring buffer
+  (load in chrome://tracing or ui.perfetto.dev);
+- ``/audit``    the resize/strategy audit log as JSON.
+
+Shutdown is clean: ``stop()`` both shuts the serve loop down AND closes
+the listening socket, so a stopped peer never leaks its telemetry port
+(the old MetricsServer left the socket open until GC).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Callable, Dict, Optional
+
+from kungfu_tpu.telemetry import audit, metrics, tracing
+
+
+class TelemetryServer:
+    def __init__(
+        self,
+        port: int,
+        host: str = "0.0.0.0",
+        registry: Optional[metrics.Registry] = None,
+        extra_routes: Optional[Dict[str, Callable[[], "tuple[str, str]"]]] = None,
+    ):
+        reg = registry or metrics.get_registry()
+        routes: Dict[str, Callable[[], "tuple[str, str]"]] = {
+            "/metrics": lambda: (reg.render(), "text/plain; version=0.0.4"),
+            "/trace": lambda: (
+                tracing.chrome_trace_json(),
+                "application/json",
+            ),
+            "/audit": lambda: (
+                json.dumps(audit.to_json()),
+                "application/json",
+            ),
+        }
+        if extra_routes:
+            routes.update(extra_routes)
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, *a):  # quiet
+                pass
+
+            def do_GET(inner):
+                route = routes.get(inner.path.rstrip("/") or "/metrics")
+                if route is None:
+                    inner.send_response(404)
+                    inner.end_headers()
+                    return
+                try:
+                    body_s, ctype = route()
+                except Exception as e:  # noqa: BLE001 - a broken view is a 500, not a crash
+                    inner.send_response(500)
+                    inner.end_headers()
+                    inner.wfile.write(str(e).encode())
+                    return
+                body = body_s.encode()
+                inner.send_response(200)
+                inner.send_header("Content-Type", ctype)
+                inner.send_header("Content-Length", str(len(body)))
+                inner.end_headers()
+                inner.wfile.write(body)
+
+        self.httpd = ThreadingHTTPServer((host, port), Handler)
+        self.port = self.httpd.server_address[1]
+        self._stopped = threading.Event()
+        self._started = False
+
+    def start(self) -> None:
+        self._started = True
+        threading.Thread(target=self.httpd.serve_forever, daemon=True).start()
+
+    def stop(self) -> None:
+        if self._stopped.is_set():
+            return
+        self._stopped.set()
+        if self._started:
+            # shutdown() handshakes with serve_forever; calling it on a
+            # never-started server blocks forever
+            self.httpd.shutdown()
+        self.httpd.server_close()  # release the port NOW, not at GC

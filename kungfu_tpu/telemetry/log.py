@@ -1,0 +1,128 @@
+"""Structured, rank-prefixed leveled logger — the host plane's one
+logging surface.
+
+Capability parity: srcs/go/log/logger.go (DEBUG/INFO/WARN/ERROR, level
+from the environment, optional redirection) + the runner's colored rank
+prefixes (utils/iostream xterm coloring) — extended with structured
+key=value fields:
+
+    log.info("resize landed", old=4, new=3)
+    # 12:00:01 [I] kungfu[0/4] resize landed old=4 new=3
+
+Level comes from ``KF_LOG_LEVEL`` (falling back to the reference's
+``KF_CONFIG_LOG_LEVEL``). The per-worker prefix comes from
+``KF_LOG_PREFIX`` (set by the runner) or, under a bare worker, from
+``KF_SELF_SPEC``. ``echo()`` is the CLI escape hatch: raw, unleveled
+stdout output for user-facing surfaces (benchmark results, server
+banners) that must never be filtered by the log level — and the reason
+``print()`` stays banned everywhere outside runner/cli.py and info/
+(see tests/test_no_bare_print.py).
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+import time
+from typing import Optional, TextIO
+
+LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "WARNING": 30, "ERROR": 40, "OFF": 100}
+_COLORS = [31, 32, 33, 34, 35, 36]  # red..cyan, cycled by rank
+
+_lock = threading.Lock()
+_state = {"level": None, "out": None, "prefix": None}
+
+
+def _level() -> int:
+    if _state["level"] is None:
+        name = os.environ.get(
+            "KF_LOG_LEVEL", os.environ.get("KF_CONFIG_LOG_LEVEL", "INFO")
+        ).upper()
+        _state["level"] = LEVELS.get(name, 20)
+    return _state["level"]
+
+
+def set_level(name: str) -> None:
+    with _lock:
+        _state["level"] = LEVELS.get(name.upper(), 20)
+
+
+def set_output(f: Optional[TextIO]) -> None:
+    """Redirect log output (parity: logger.go output redirection)."""
+    with _lock:
+        _state["out"] = f
+
+
+def reset() -> None:
+    """Re-read level/prefix from the environment (tests)."""
+    with _lock:
+        _state["level"] = None
+        _state["prefix"] = None
+
+
+def _prefix() -> str:
+    if _state["prefix"] is None:
+        p = os.environ.get("KF_LOG_PREFIX", "") or os.environ.get(
+            "KF_SELF_SPEC", ""
+        )
+        if p and sys.stderr.isatty():
+            try:
+                rank = int(p.split("/")[0])
+                p = f"\x1b[{_COLORS[rank % len(_COLORS)]}m[{p}]\x1b[0m"
+            except ValueError:
+                p = f"[{p}]"
+        elif p:
+            p = f"[{p}]"
+        _state["prefix"] = p
+    return _state["prefix"]
+
+
+def _emit(level_name: str, level: int, msg: str, args: tuple, fields: dict) -> None:
+    if level < _level():
+        return
+    out = _state["out"] or sys.stderr
+    if args:
+        msg = msg % args
+    if fields:
+        kv = " ".join(f"{k}={v}" for k, v in fields.items())
+        msg = f"{msg} {kv}" if msg else kv
+    ts = time.strftime("%H:%M:%S")
+    pre = _prefix()
+    with _lock:
+        try:
+            out.write(f"{ts} [{level_name[0]}] kungfu{pre} {msg}\n")
+            out.flush()
+        except (ValueError, OSError):
+            pass  # closed stream at interpreter teardown
+
+
+def debug(msg: str, *args, **fields) -> None:
+    _emit("DEBUG", 10, msg, args, fields)
+
+
+def info(msg: str, *args, **fields) -> None:
+    _emit("INFO", 20, msg, args, fields)
+
+
+def warn(msg: str, *args, **fields) -> None:
+    _emit("WARN", 30, msg, args, fields)
+
+
+warning = warn
+
+
+def error(msg: str, *args, **fields) -> None:
+    _emit("ERROR", 40, msg, args, fields)
+
+
+def echo(msg: str = "", *, err: bool = False) -> None:
+    """Raw CLI-facing output (results, banners): bypasses levels and
+    prefixes, never filtered. The lint-compliant replacement for print()
+    in CLI surfaces outside runner/cli.py and info/."""
+    out = sys.stderr if err else sys.stdout
+    try:
+        out.write(str(msg) + "\n")
+        out.flush()
+    except (ValueError, OSError):
+        pass

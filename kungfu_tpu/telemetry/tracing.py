@@ -1,0 +1,189 @@
+"""Span-based tracing with Chrome-trace/Perfetto JSON export.
+
+The successor of the old ``kungfu_tpu.utils.trace`` scoped tracer (which
+now re-exports this module): named spans carried in a bounded ring
+buffer — recording is always-on because a span is two perf_counter
+calls, a small tuple and a deque append — plus:
+
+- nesting: each thread keeps a span stack, so events know their depth
+  and parent (tested by the collective-step nesting test);
+- attributes: ``span("allreduce", bytes=n)`` attaches args that survive
+  into the Chrome trace's ``args`` field;
+- export: :func:`chrome_trace` renders the buffer as a Chrome
+  ``traceEvents`` JSON object (``ph``/``ts``/``dur`` complete events,
+  ``i`` instants) loadable by chrome://tracing and ui.perfetto.dev.
+
+Capability parity: the reference compiles TRACE_SCOPE into its hot paths
+(srcs/cpp/include/kungfu/utils/trace.hpp); the ring-buffer + JSON export
+follows the standard Chrome trace-event format.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+from collections import deque
+from typing import Dict, List, NamedTuple, Optional, Tuple
+
+try:
+    MAX_EVENTS = int(os.environ.get("KF_TRACE_BUFFER", "8192") or 8192)
+except ValueError:  # malformed env must not kill worker startup
+    MAX_EVENTS = 8192
+
+
+class TraceEvent(NamedTuple):
+    name: str
+    start: float  # perf_counter seconds
+    duration: float  # seconds; 0.0 for instants
+    tid: int
+    depth: int  # nesting depth at record time (0 = top level)
+    phase: str  # "X" complete | "i" instant
+    args: Optional[dict]
+
+
+_lock = threading.Lock()
+_events: "deque[TraceEvent]" = deque(maxlen=MAX_EVENTS)
+_tls = threading.local()
+
+
+def _stack() -> list:
+    st = getattr(_tls, "stack", None)
+    if st is None:
+        st = _tls.stack = []
+    return st
+
+
+def _append(ev: TraceEvent) -> None:
+    with _lock:
+        _events.append(ev)
+
+
+class _Span:
+    """Class-based context manager (NOT @contextmanager: spans sit on
+    every collective/transport call and generator CMs cost ~3x more to
+    enter). Records a complete event on exit; nesting depth comes from a
+    per-thread stack."""
+
+    __slots__ = ("name", "args", "t0", "depth")
+
+    def __init__(self, name: str, args: Optional[dict]):
+        self.name = name
+        self.args = args
+
+    def __enter__(self):
+        st = _stack()
+        self.depth = len(st)
+        st.append(self.name)
+        self.t0 = time.perf_counter()
+        return self
+
+    def __exit__(self, *exc):
+        dt = time.perf_counter() - self.t0
+        _stack().pop()
+        _append(
+            TraceEvent(
+                self.name, self.t0, dt, threading.get_ident(), self.depth,
+                "X", self.args,
+            )
+        )
+        return False
+
+
+def span(name: str, **args) -> _Span:
+    """Time a scope: ``with span("allreduce", bytes=n): ...``."""
+    return _Span(name, args or None)
+
+
+def record(name: str, duration_s: float, **args) -> None:
+    """Record an externally-timed span ending now (back-compat with the
+    old trace.record call sites)."""
+    _append(
+        TraceEvent(
+            name,
+            time.perf_counter() - duration_s,
+            duration_s,
+            threading.get_ident(),
+            len(_stack()),
+            "X",
+            args or None,
+        )
+    )
+
+
+def instant(name: str, **args) -> None:
+    """Record a point-in-time event (resize, strategy switch, ...)."""
+    _append(
+        TraceEvent(
+            name, time.perf_counter(), 0.0, threading.get_ident(),
+            len(_stack()), "i", args or None,
+        )
+    )
+
+
+def events(prefix: str = "") -> List[Tuple[str, float, float]]:
+    """(name, start, duration) tuples — the legacy utils.trace shape."""
+    return [
+        (e.name, e.start, e.duration) for e in full_events(prefix)
+    ]
+
+
+def full_events(prefix: str = "") -> List[TraceEvent]:
+    with _lock:
+        evs = list(_events)
+    if prefix:
+        evs = [e for e in evs if e.name.startswith(prefix)]
+    return evs
+
+
+def clear() -> None:
+    with _lock:
+        _events.clear()
+
+
+def summary_ms(prefix: str = "") -> Dict[str, float]:
+    """Total duration per span name (ms), filtered by prefix."""
+    out: Dict[str, float] = {}
+    for e in full_events(prefix):
+        out[e.name] = out.get(e.name, 0.0) + e.duration * 1e3
+    return {k: round(v, 1) for k, v in out.items()}
+
+
+def chrome_trace(prefix: str = "") -> dict:
+    """The buffer as a Chrome trace-event JSON object.
+
+    Timestamps are perf_counter microseconds (a process-relative
+    monotonic epoch — exactly what the trace viewers expect).
+    """
+    pid = os.getpid()
+    trace_events = []
+    for e in full_events(prefix):
+        ev = {
+            "name": e.name,
+            "ph": e.phase,
+            "ts": e.start * 1e6,
+            "pid": pid,
+            "tid": e.tid,
+            "cat": "kungfu",
+        }
+        if e.phase == "X":
+            ev["dur"] = e.duration * 1e6
+        else:
+            ev["s"] = "t"  # thread-scoped instant
+        args = dict(e.args) if e.args else {}
+        args["depth"] = e.depth
+        ev["args"] = args
+        trace_events.append(ev)
+    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+
+
+def chrome_trace_json(prefix: str = "") -> str:
+    return json.dumps(chrome_trace(prefix))
+
+
+def export_chrome(path: str, prefix: str = "") -> str:
+    """Write the Chrome trace JSON to `path`; returns the path."""
+    with open(path, "w") as f:
+        f.write(chrome_trace_json(prefix))
+    return path

@@ -63,6 +63,22 @@ class Client:
         from kungfu_tpu.monitor import net as _net
 
         self._monitor = _net.get_monitor() if _net.enabled() else None
+        # latency histograms ride the same gate as the byte counters: a
+        # histogram observe is a bisect + three adds, but the send path
+        # runs per message and stays untouched when telemetry is off
+        self._send_hist = self._rtt_hist = None
+        if self._monitor is not None:
+            from kungfu_tpu.telemetry import metrics as _tmetrics
+
+            self._send_hist = _tmetrics.histogram(
+                "kungfu_transport_send_seconds",
+                "Host-transport send latency (frame + flush)",
+            )
+            self._rtt_hist = _tmetrics.histogram(
+                "kungfu_transport_rtt_seconds",
+                "Ping round-trip time per peer",
+                ("peer",),
+            )
 
     def set_token(self, token: int) -> None:
         self._token = token
@@ -200,16 +216,24 @@ class Client:
                 if shm_conn:
                     self._fresh_arena(key)
                 send_message(sock, wire_message())
-            trace.record("transport.send", time.perf_counter() - _t0)
+            _dt = time.perf_counter() - _t0
+            trace.record("transport.send", _dt)
+            if self._send_hist is not None:
+                self._send_hist.observe(_dt)
         if self._monitor is not None:
             self._monitor.sent(peer, data_len)
 
     def ping(self, peer: PeerID, timeout: float = 2.0) -> bool:
         try:
+            _t0 = time.perf_counter()
             sock = socket.create_connection((peer.host, peer.port), timeout=timeout)
             send_header(sock, ConnType.PING, self.self_id.host, self.self_id.port, 0)
             recv_ack(sock)
             sock.close()
+            if self._rtt_hist is not None:
+                self._rtt_hist.labels(str(peer)).observe(
+                    time.perf_counter() - _t0
+                )
             return True
         except (ConnectionError, OSError):
             return False

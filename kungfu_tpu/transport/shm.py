@@ -81,6 +81,23 @@ class SenderArena:
     def __init__(self, path: str, capacity: int = DEFAULT_CAPACITY):
         self.path = path
         self.capacity = capacity
+        # ring-vs-socket accounting (telemetry): a rising fallback share
+        # means the receiver is chronically behind and payloads are taking
+        # the slower socket path; gated once per arena, zero-cost when off
+        self._m_writes = self._m_fallback = None
+        from kungfu_tpu.telemetry import config as _tcfg
+
+        if _tcfg.metrics_enabled():
+            from kungfu_tpu.telemetry import metrics as _tm
+
+            self._m_writes = _tm.counter(
+                "kungfu_shm_writes_total",
+                "Payloads delivered via the shared-memory ring",
+            )
+            self._m_fallback = _tm.counter(
+                "kungfu_shm_fallback_total",
+                "Ring-full fallbacks to the socket frame path",
+            )
         # O_EXCL after unlink: the path is predictable, so opening an
         # existing file could map another local user's pre-planted file
         # (mode 0o600 only applies at creation) — never reuse one
@@ -111,11 +128,16 @@ class SenderArena:
         path's kernel flow control is the right way to wait for it."""
         cap = self.capacity
         if nbytes > cap:
+            # deliberate routing (payload can never fit), not backpressure
+            # — excluded from the fallback counter, whose point is "the
+            # receiver is behind"
             return None
         off = self._alloc % cap
         pad = cap - off if off + nbytes > cap else 0
         advance = pad + nbytes
         if self._alloc + advance - int(self._seq[1]) > cap:
+            if self._m_fallback is not None:
+                self._m_fallback.inc()
             return None
         start = 0 if pad else off
         dst = np.frombuffer(self._data, np.uint8, nbytes, offset=start)
@@ -123,6 +145,8 @@ class SenderArena:
         np.copyto(dst, src)  # releases the GIL for large copies
         self._alloc += advance
         self._seq[0] = self._alloc
+        if self._m_writes is not None:
+            self._m_writes.inc()
         return DESC.pack(start, nbytes, advance)
 
     def close(self) -> None:

@@ -1,80 +1,21 @@
-"""Leveled logger with per-process prefixes.
+"""Back-compat shim: the leveled logger moved to kungfu_tpu.telemetry.log.
 
-Capability parity: srcs/go/log/logger.go — DEBUG/INFO/WARN/ERROR levels,
-level set from the environment (KF_CONFIG_LOG_LEVEL), optional redirection
-to a logfile. The runner gives every worker a colored rank prefix (parity:
-utils/iostream xterm coloring) via KF_LOG_PREFIX.
+Same API (debug/info/warn/error with %-args, set_level, set_output)
+plus structured key=value fields and ``echo()`` for CLI surfaces; level
+honours KF_LOG_LEVEL with fallback to the legacy KF_CONFIG_LOG_LEVEL.
 """
 
 from __future__ import annotations
 
-import os
-import sys
-import threading
-import time
-from typing import Optional, TextIO
-
-LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "ERROR": 40, "OFF": 100}
-_COLORS = [31, 32, 33, 34, 35, 36]  # red..cyan, cycled by rank
-
-_lock = threading.Lock()
-_state = {"level": None, "out": None, "prefix": None}
-
-
-def _level() -> int:
-    if _state["level"] is None:
-        name = os.environ.get("KF_CONFIG_LOG_LEVEL", "INFO").upper()
-        _state["level"] = LEVELS.get(name, 20)
-    return _state["level"]
-
-
-def set_level(name: str) -> None:
-    with _lock:
-        _state["level"] = LEVELS.get(name.upper(), 20)
-
-
-def set_output(f: Optional[TextIO]) -> None:
-    """Redirect log output (parity: logger.go output redirection)."""
-    with _lock:
-        _state["out"] = f
-
-
-def _prefix() -> str:
-    if _state["prefix"] is None:
-        p = os.environ.get("KF_LOG_PREFIX", "")
-        if p and sys.stderr.isatty():
-            try:
-                rank = int(p.split("/")[0])
-                p = f"\x1b[{_COLORS[rank % len(_COLORS)]}m[{p}]\x1b[0m"
-            except ValueError:
-                p = f"[{p}]"
-        elif p:
-            p = f"[{p}]"
-        _state["prefix"] = p
-    return _state["prefix"]
-
-
-def _emit(level_name: str, level: int, msg: str) -> None:
-    if level < _level():
-        return
-    out = _state["out"] or sys.stderr
-    ts = time.strftime("%H:%M:%S")
-    pre = _prefix()
-    with _lock:
-        print(f"{ts} [{level_name[0]}] kungfu{pre} {msg}", file=out, flush=True)
-
-
-def debug(msg: str, *args) -> None:
-    _emit("DEBUG", 10, msg % args if args else msg)
-
-
-def info(msg: str, *args) -> None:
-    _emit("INFO", 20, msg % args if args else msg)
-
-
-def warn(msg: str, *args) -> None:
-    _emit("WARN", 30, msg % args if args else msg)
-
-
-def error(msg: str, *args) -> None:
-    _emit("ERROR", 40, msg % args if args else msg)
+from kungfu_tpu.telemetry.log import (  # noqa: F401
+    LEVELS,
+    debug,
+    echo,
+    error,
+    info,
+    reset,
+    set_level,
+    set_output,
+    warn,
+    warning,
+)

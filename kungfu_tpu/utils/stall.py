@@ -9,16 +9,17 @@ around collective calls and resize paths (libkungfu-comm/main.go:179-190).
 from __future__ import annotations
 
 import contextlib
-import os
-import sys
 import threading
 import time
+
+from kungfu_tpu.telemetry import config as _tconfig
+from kungfu_tpu.telemetry import log as _log
 
 DEFAULT_PERIOD = 3.0
 
 
 def enabled() -> bool:
-    return os.environ.get("KF_CONFIG_ENABLE_STALL_DETECTION", "") in ("1", "true")
+    return _tconfig.env_truthy("KF_CONFIG_ENABLE_STALL_DETECTION")
 
 
 @contextlib.contextmanager
@@ -35,7 +36,7 @@ def stall_detect(name: str, period: float = DEFAULT_PERIOD, force: bool = False)
         while not done.wait(period):
             n += 1
             elapsed = time.monotonic() - t0
-            print(f"kungfu_tpu: {name} stalled for {elapsed:.1f}s", file=sys.stderr)
+            _log.warn("%s stalled for %.1fs", name, elapsed)
 
     watcher = threading.Thread(target=watch, daemon=True)
     watcher.start()

@@ -1,56 +1,24 @@
-"""Scoped tracer: named spans with wall-clock durations.
+"""Back-compat shim: the scoped tracer moved to kungfu_tpu.telemetry.tracing.
 
-Capability parity: the reference's profiling hooks (experimental/hook/
-elastic.py ResizeProfiler, srcs/go tracing helpers) — lightweight,
-always-on (a span is two perf_counter calls and a deque append), queried
-by benchmarks and surfaced per-resize by the peer.
+Every existing ``utils.trace`` call site (transport, collective walks,
+elastic resize phases, benchmarks) now records into the unified
+telemetry ring buffer, so the spans show up in ``/trace`` Chrome-trace
+exports and ``telemetry.dump()`` alongside metrics and audit records.
 """
 
 from __future__ import annotations
 
-import threading
-import time
-from collections import deque
-from contextlib import contextmanager
-from typing import Dict, List, Tuple
-
-_lock = threading.Lock()
-_events: "deque[Tuple[str, float, float]]" = deque(maxlen=4096)
-
-
-@contextmanager
-def span(name: str):
-    """Time a scope; records (name, start, duration_s)."""
-    t0 = time.perf_counter()
-    try:
-        yield
-    finally:
-        dt = time.perf_counter() - t0
-        with _lock:
-            _events.append((name, t0, dt))
-
-
-def record(name: str, duration_s: float) -> None:
-    with _lock:
-        _events.append((name, time.perf_counter(), duration_s))
-
-
-def events(prefix: str = "") -> List[Tuple[str, float, float]]:
-    with _lock:
-        evs = list(_events)
-    if prefix:
-        evs = [e for e in evs if e[0].startswith(prefix)]
-    return evs
-
-
-def clear() -> None:
-    with _lock:
-        _events.clear()
-
-
-def summary_ms(prefix: str = "") -> Dict[str, float]:
-    """Total duration per span name (ms), filtered by prefix."""
-    out: Dict[str, float] = {}
-    for name, _, dt in events(prefix):
-        out[name] = out.get(name, 0.0) + dt * 1e3
-    return {k: round(v, 1) for k, v in out.items()}
+from kungfu_tpu.telemetry.tracing import (  # noqa: F401
+    MAX_EVENTS,
+    TraceEvent,
+    chrome_trace,
+    chrome_trace_json,
+    clear,
+    events,
+    export_chrome,
+    full_events,
+    instant,
+    record,
+    span,
+    summary_ms,
+)

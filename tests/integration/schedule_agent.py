@@ -96,6 +96,14 @@ def main() -> int:
         assert last_loss < 0.5 * first_loss, (
             f"no convergence across resizes: {first_loss} -> {last_loss}"
         )
+    # telemetry audit: every membership change this worker lived through
+    # must have left a structured record with sane sizes + a trigger
+    audits = api.resize_audit()
+    assert audits, "schedule-driven resizes left no audit records"
+    for rec in audits:
+        assert rec["old_size"] != rec["new_size"], rec
+        assert rec["trigger"] == "config_server", rec
+        assert rec["phases_ms"], rec
     return 0
 
 
