@@ -92,12 +92,23 @@ def group_all_reduce_arrays(
     else:
         if len(outs) != len(xs):
             raise ValueError(f"outs mismatch: {len(outs)} != {len(xs)}")
-        for o in outs:
+        for i, (o, f) in enumerate(zip(outs, flats)):
             # reshape(-1) of a non-contiguous array is a COPY — the
             # collective would fill the copy and the caller's buffer
             # would silently keep last step's data
             if not o.flags["C_CONTIGUOUS"]:
                 raise ValueError("outs arrays must be C-contiguous")
+            # size/dtype mismatches reach the native reduce as raw
+            # pointers: a short recv buffer is an out-of-bounds WRITE,
+            # a dtype mismatch reinterprets bytes — both must fail here
+            if o.size != f.size:
+                raise ValueError(
+                    f"outs[{i}] size {o.size} != input size {f.size}"
+                )
+            if o.dtype != f.dtype:
+                raise ValueError(
+                    f"outs[{i}] dtype {o.dtype} != input dtype {f.dtype}"
+                )
         flat_outs = [o.reshape(-1) for o in outs]
     ws = [
         Workspace(send=f, recv=o, op=op, name=f"kungfu::user::{name}:{i}")

@@ -30,6 +30,7 @@ from kungfu_tpu.base.strategy import Strategy
 from kungfu_tpu.collective.adaptive import AdaptiveState
 from kungfu_tpu.base.workspace import Workspace, even_partition
 from kungfu_tpu.collective import strategies as st
+from kungfu_tpu.collective.strategies import effective_cpu_count
 from kungfu_tpu.plan.graph import Graph
 from kungfu_tpu.plan.peer import PeerID, PeerList
 from kungfu_tpu.transport.client import Client
@@ -239,11 +240,13 @@ class HostSession:
 
     # concurrent workspaces per batch in group ops: concurrency only pays
     # when cores exist to run the walks (on a 1-core host it just adds
-    # context switches), so the default scales with cpu count;
-    # KF_CONFIG_GROUP_WINDOW overrides
+    # context switches), so the default scales with the cgroup-aware
+    # core count — os.cpu_count() reports the HOST's cores inside a
+    # CPU-quota'd container, the phantom-parallelism trap auto_select
+    # already avoids; KF_CONFIG_GROUP_WINDOW overrides
     GROUP_WINDOW = int(
         os.environ.get("KF_CONFIG_GROUP_WINDOW", "")
-        or max(1, min(8, os.cpu_count() or 1))
+        or max(1, min(8, effective_cpu_count()))
     )
 
     # Gradient bucketing: fuse same-(dtype, op) workspaces into ONE

@@ -47,6 +47,58 @@ def choose(sl: StrategyList, i: int) -> StrategyPair:
     return sl[i % len(sl)]
 
 
+# cgroup CPU-limit files; module constants so tests can point them at
+# fixtures. v2 first (the unified hierarchy every modern container
+# runtime mounts), v1 cfs_quota as fallback.
+CGROUP_V2_CPU_MAX = "/sys/fs/cgroup/cpu.max"
+CGROUP_V1_QUOTA = "/sys/fs/cgroup/cpu/cpu.cfs_quota_us"
+CGROUP_V1_PERIOD = "/sys/fs/cgroup/cpu/cpu.cfs_period_us"
+
+
+def _cgroup_cpu_quota() -> float:
+    """CPU quota in cores from the cgroup limit, or 0.0 when unlimited
+    or unreadable. v2: ``cpu.max`` is "<quota> <period>" or "max ...";
+    v1: cfs_quota_us / cfs_period_us, quota -1 meaning unlimited."""
+    try:
+        with open(CGROUP_V2_CPU_MAX) as f:
+            quota_s, _, period_s = f.read().strip().partition(" ")
+        if quota_s != "max":
+            quota = float(quota_s) / float(period_s or 100000)
+            if quota > 0:
+                return quota
+    except (OSError, ValueError, ZeroDivisionError):
+        pass
+    try:
+        with open(CGROUP_V1_QUOTA) as f:
+            quota_us = int(f.read().strip())
+        if quota_us > 0:
+            with open(CGROUP_V1_PERIOD) as f:
+                period_us = int(f.read().strip())
+            if period_us > 0:
+                return quota_us / period_us
+    except (OSError, ValueError):
+        pass
+    return 0.0
+
+
+def effective_cpu_count() -> int:
+    """Cores this process can actually burn: os.cpu_count() capped by
+    the affinity mask AND the cgroup CPU quota. In a CPU-quota'd
+    container os.cpu_count() reports the host's cores — phantom
+    parallelism that made auto_select pick k concurrent root walks on
+    what is effectively a 1-core box."""
+    cores = os.cpu_count() or 1
+    if hasattr(os, "sched_getaffinity"):
+        try:
+            cores = min(cores, len(os.sched_getaffinity(0)))
+        except OSError:
+            pass
+    quota = _cgroup_cpu_quota()
+    if quota > 0:
+        cores = min(cores, int(quota))
+    return max(1, cores)
+
+
 def auto_select(peers: PeerList) -> Strategy:
     """Single host: CLIQUE (one star per root) so chunked collectives
     stripe across k roots instead of funnelling 2(k-1)x the payload
@@ -55,15 +107,20 @@ def auto_select(peers: PeerList) -> Strategy:
     host has cores to run the concurrent walks. On a 1-2 core host the
     k root walks time-slice one CPU and the context switching costs more
     than the striping saves (measured 2.5x slower than a single tree at
-    np=4 on 1 vCPU), so prefer one binary tree there. Pair 0 is
-    rank-0-rooted, preserving the gather/broadcast root contract.
-    Multi-host: one binary-tree-star per host master (same striping
-    argument across hosts)."""
+    np=4 on 1 vCPU), so prefer one binary tree there — counting cores
+    the cgroup-aware way (effective_cpu_count), since a CPU-quota'd
+    container reports the host's cores while scheduling only a few.
+    Pair 0 is rank-0-rooted, preserving the gather/broadcast root
+    contract. Multi-host: one binary-tree-star per host master (same
+    striping argument across hosts)."""
     if peers.host_count() == 1:
         if len(peers) <= 2:
             return Strategy.STAR
-        cores = os.cpu_count() or 1
-        return Strategy.CLIQUE if cores >= 4 else Strategy.BINARY_TREE
+        return (
+            Strategy.CLIQUE
+            if effective_cpu_count() >= 4
+            else Strategy.BINARY_TREE
+        )
     return Strategy.MULTI_BINARY_TREE_STAR
 
 

@@ -1,4 +1,5 @@
 """Usage: python3 -m kungfu_tpu.info [--no-devices] [--telemetry [URL]]
+       python3 -m kungfu_tpu.info top [--watch] [--interval S] [URL]
 
 Prints framework, backend and cluster-env diagnostics (parity:
 python -m kungfu.info; the CUDA/NCCL/TF report becomes JAX/TPU/KF_* —
@@ -7,10 +8,20 @@ what an operator actually needs when a TPU-VM worker misbehaves).
 --telemetry shows the telemetry configuration (KF_TELEMETRY features,
 endpoint scheme) and, given a worker URL (http://host:port — the
 worker's peer port + 10000), fetches and prints its live /metrics
-page."""
+page.
 
+`top` is the live operator view of the cluster plane (ISSUE 2): it
+reads the runner's /cluster/health endpoint (URL argument, or
+KF_CLUSTER_HEALTH_URL — exported to every worker by kfrun -w
+-debug-port N) and renders one row per peer: step rate, step-time
+p50/p99, bytes tx/rx, scrape age, straggler flag. --watch refreshes in
+place until interrupted."""
+
+import json
 import os
 import sys
+import time
+import urllib.request
 
 
 def _show_versions() -> None:
@@ -89,7 +100,112 @@ def _show_telemetry(argv) -> None:
         print(d["metrics"])
 
 
+def _fmt_num(v, fmt="{:.1f}", dash="-") -> str:
+    return fmt.format(v) if isinstance(v, (int, float)) else dash
+
+
+def _fmt_bytes(v) -> str:
+    if not isinstance(v, (int, float)):
+        return "-"
+    for unit in ("B", "KiB", "MiB", "GiB", "TiB"):
+        if abs(v) < 1024 or unit == "TiB":
+            return f"{v:.1f}{unit}" if unit != "B" else f"{int(v)}B"
+        v /= 1024
+    return "-"
+
+
+def render_top(health: dict) -> str:
+    """One refresh frame of `info top`: a fixed-width table over
+    /cluster/health, stragglers flagged in the last column."""
+    cols = ("PEER", "STEP/S", "P50(ms)", "P99(ms)", "TX", "RX",
+            "RTT(ms)", "AGE(s)", "FLAGS")
+    rows = [cols]
+    peers = health.get("peers", {})
+    for label in sorted(peers):
+        p = peers[label]
+        flags = []
+        if p.get("straggler"):
+            flags.append("STRAGGLER")
+        if p.get("rtt_outlier"):
+            flags.append("RTT")
+        if p.get("error"):
+            flags.append("UNREACHABLE")
+        rows.append((
+            label,
+            _fmt_num(p.get("step_rate"), "{:.2f}"),
+            _fmt_num(p.get("step_time_p50_ms")),
+            _fmt_num(p.get("step_time_p99_ms")),
+            _fmt_bytes(p.get("bytes_tx")),
+            _fmt_bytes(p.get("bytes_rx")),
+            _fmt_num(p.get("rtt_ms"), "{:.2f}"),
+            _fmt_num(p.get("last_scrape_age_s")),
+            ",".join(flags) or "ok",
+        ))
+    widths = [max(len(str(r[i])) for r in rows) for i in range(len(cols))]
+    lines = ["  ".join(str(c).ljust(w) for c, w in zip(r, widths)).rstrip()
+             for r in rows]
+    skew = health.get("step_skew")
+    stragglers = health.get("stragglers", [])
+    summary = (
+        f"{len(peers)} peers"
+        + (f", step skew {skew:.2f}x" if isinstance(skew, (int, float)) else "")
+        + (f", STRAGGLERS: {', '.join(stragglers)}" if stragglers else "")
+    )
+    return "\n".join([summary] + lines)
+
+
+def _cmd_top(argv) -> int:
+    watch = "--watch" in argv
+    interval = 2.0
+    if "--interval" in argv:
+        idx = argv.index("--interval")
+        try:
+            interval = float(argv[idx + 1])
+        except (IndexError, ValueError):
+            print("info top: --interval wants seconds, e.g. --interval 2",
+                  file=sys.stderr)
+            return 2
+    urls = [a for a in argv if a.startswith("http")]
+    url = urls[0] if urls else os.environ.get("KF_CLUSTER_HEALTH_URL", "")
+    if not url:
+        print(
+            "info top: no /cluster/health URL — pass one, or run under "
+            "kfrun -w -debug-port N (which exports KF_CLUSTER_HEALTH_URL)",
+            file=sys.stderr,
+        )
+        return 2
+    while True:
+        # the whole iteration is interruptible: Ctrl-C mostly lands
+        # inside the urlopen (5s timeout dwarfs the sleep on a sick
+        # runner), and "until interrupted" means a clean exit there too
+        try:
+            try:
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    health = json.loads(r.read().decode())
+                frame = render_top(health)
+            except (OSError, ValueError) as e:
+                # watch mode rides out transient blips (runner
+                # mid-restart, one slow scrape) instead of killing the
+                # live view
+                if not watch:
+                    print(f"info top: fetch {url} failed: {e}",
+                          file=sys.stderr)
+                    return 1
+                frame = f"info top: fetch failed, retrying: {e}"
+            if watch:
+                # home + clear-to-end keeps the table refreshing in place
+                print("\x1b[H\x1b[2J" + frame, flush=True)
+                time.sleep(interval)
+            else:
+                print(frame)
+                return 0
+        except KeyboardInterrupt:
+            return 0
+
+
 def main(argv) -> None:
+    if argv and argv[0] == "top":
+        sys.exit(_cmd_top(argv[1:]))
     _show_versions()
     if "--no-devices" not in argv:
         _show_devices()

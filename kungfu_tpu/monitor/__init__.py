@@ -18,7 +18,22 @@ _NOISE = ("GNSState", "gns_init", "gns_update", "monitor_gradient_noise_scale",
 _VARIANCE = ("monitor_gradient_variance", "gradient_variance",
              "publish_gradient_variance")
 
-__all__ = list(_NOISE + _VARIANCE)
+__all__ = list(_NOISE + _VARIANCE) + ["cluster_health"]
+
+
+def cluster_health(max_age: float = 5.0) -> dict:
+    """Cluster-level health signals for the adaptation layer (ISSUE 2).
+
+    Returns the flattened ``cluster/*`` signal dict derived from the
+    runner-side TelemetryAggregator's snapshot: straggler list, per-peer
+    straggler scores, step-time skew, RTT outliers, and whether THIS
+    worker is flagged. In the runner process it reads the in-process
+    aggregator; in a worker it polls the watcher's ``/cluster/health``
+    endpoint (``KF_CLUSTER_HEALTH_URL``, injected at spawn) with an
+    ``max_age``-second cache. Empty dict when no cluster plane is up.
+    """
+    mod = importlib.import_module("kungfu_tpu.telemetry.cluster")
+    return mod.health_signals(max_age)
 
 
 def __getattr__(name):

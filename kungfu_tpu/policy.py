@@ -5,11 +5,22 @@ policy_hook}.py — a BasePolicy gets before/after train/epoch/step
 callbacks; the runner tracks trained samples and a mutable batch size and
 stops when this worker is detached (policy_hook.py:8-77). Framework-
 agnostic here: drive it from any JAX training loop.
+
+The monitor→adapt loop (ISSUE 2): each step the runner publishes this
+worker's step timing into the telemetry registry
+(``kungfu_steps_total`` + ``kungfu_step_duration_seconds``) — the raw
+series the cluster aggregator scrapes for straggler detection — and
+pulls the aggregator's cluster-health signals back into
+``PolicyContext.metrics`` (``cluster/stragglers``,
+``cluster/step_skew``, ``cluster/self_straggler``, ...) so a
+``BasePolicy`` can trigger a resize or strategy switch on cross-peer
+skew. See :class:`StragglerPolicy` for the canonical consumer.
 """
 
 from __future__ import annotations
 
-from typing import List, Optional
+import time
+from typing import Callable, List, Optional
 
 
 class BasePolicy:
@@ -54,10 +65,48 @@ class PolicyRunner:
                     if runner.ctx.stopped: ...
     """
 
+    # refresh cluster signals into ctx.metrics at most this often; the
+    # underlying fetch is TTL-cached too, so a step costs a float compare
+    CLUSTER_SIGNAL_PERIOD = 2.0
+
     def __init__(self, policies: List[BasePolicy], batch_size: int,
                  total_samples: Optional[int] = None):
         self.policies = policies
         self.ctx = PolicyContext(batch_size, total_samples)
+        self._step_t0 = 0.0
+        self._signals_at = 0.0
+        # step-time publication: the per-worker series behind the cluster
+        # plane's straggler detection; gated once, zero-cost when off
+        self._m_steps = self._m_step_hist = None
+        from kungfu_tpu.telemetry import config as _tcfg
+
+        if _tcfg.metrics_enabled():
+            from kungfu_tpu.telemetry import metrics as _tm
+
+            self._m_steps = _tm.counter(
+                "kungfu_steps_total",
+                "Training steps completed by this worker",
+            )
+            self._m_step_hist = _tm.histogram(
+                "kungfu_step_duration_seconds",
+                "Wall-clock duration of each training step",
+            )
+
+    def _pull_cluster_signals(self) -> None:
+        """Merge the aggregator's cluster-health signals into
+        ctx.metrics (throttled; absent plane = no-op)."""
+        now = time.monotonic()
+        if now - self._signals_at < self.CLUSTER_SIGNAL_PERIOD:
+            return
+        self._signals_at = now
+        try:
+            from kungfu_tpu import monitor
+
+            signals = monitor.cluster_health()
+        except Exception:  # noqa: BLE001 - telemetry must never kill training
+            return
+        if signals:
+            self.ctx.metrics.update(signals)
 
     def __enter__(self):
         for p in self.policies:
@@ -79,7 +128,17 @@ class PolicyRunner:
         )
 
     def step(self):
+        def before():
+            self._step_t0 = time.perf_counter()
+            for p in self.policies:
+                p.before_step(self.ctx)
+
         def after():
+            dt = time.perf_counter() - self._step_t0
+            if self._m_steps is not None:
+                self._m_steps.inc()
+                self._m_step_hist.observe(dt)
+            self._pull_cluster_signals()
             self.ctx.trained_samples += self.ctx.batch_size
             self.ctx.step += 1
             for p in self.policies:
@@ -97,10 +156,54 @@ class PolicyRunner:
             ):
                 self.ctx.request_stop()
 
-        return _Scope(
-            enter=lambda: [p.before_step(self.ctx) for p in self.policies],
-            exit=after,
+        return _Scope(enter=before, exit=after)
+
+
+class StragglerPolicy(BasePolicy):
+    """Adaptation on cluster skew: when the cluster plane flags the same
+    straggler for `patience` consecutive signal refreshes, invoke
+    `on_straggler(ctx, peers)` — typically an `api.resize(size-1)` to
+    shed the slow peer, or a strategy switch away from topologies rooted
+    on it. The default action just records the decision in ctx.metrics
+    (``cluster/straggler_action_pending``) so embedders can act in the
+    training loop, where collective calls are safe.
+    """
+
+    def __init__(
+        self,
+        patience: int = 3,
+        on_straggler: Optional[Callable[["PolicyContext", List[str]], None]] = None,
+    ):
+        self.patience = patience
+        self.on_straggler = on_straggler
+        self._seen: dict = {}  # peer -> consecutive flags
+        self._last_update = None
+
+    def after_step(self, ctx: "PolicyContext") -> None:
+        flagged = ctx.metrics.get("cluster/stragglers")
+        if flagged is None:
+            return
+        # count once per signal REFRESH, not once per step (steps are
+        # orders of magnitude faster than scrapes). The refresh marker
+        # is cluster/updated_at — a steady straggler produces identical
+        # flag lists every refresh, so content can't mark freshness.
+        update = ctx.metrics.get("cluster/updated_at")
+        if update is not None and update == self._last_update:
+            return
+        self._last_update = update
+        self._seen = {
+            p: self._seen.get(p, 0) + 1 for p in flagged
+        }
+        persistent = sorted(
+            p for p, n in self._seen.items() if n >= self.patience
         )
+        if not persistent:
+            return
+        if self.on_straggler is not None:
+            self.on_straggler(ctx, persistent)
+        else:
+            ctx.metrics["cluster/straggler_action_pending"] = persistent
+        self._seen = {p: 0 for p in self._seen}
 
 
 class _Scope:

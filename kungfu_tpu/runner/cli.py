@@ -71,7 +71,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="partition this many chip ids among local workers "
                         "(TPU_VISIBLE_DEVICES pinning; 0 = no pinning)")
     p.add_argument("-debug-port", type=int, default=-1,
-                   help="HTTP endpoint dumping seen Stages (0 = ephemeral)")
+                   help="HTTP endpoint: Stage dumps + /cluster/{metrics,"
+                        "trace,health} telemetry (0 = ephemeral)")
     p.add_argument("-logdir", default="")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("-delay", type=float, default=0.0)
@@ -169,7 +170,8 @@ def main(argv: Optional[List[str]] = None) -> int:
 
     if args.debug_port >= 0 and not args.watch:
         print(
-            "kfrun: -debug-port only serves Stage dumps in watch mode (-w); ignoring",
+            "kfrun: -debug-port (Stage dumps + /cluster telemetry) needs "
+            "watch mode (-w); ignoring",
             file=sys.stderr,
         )
 

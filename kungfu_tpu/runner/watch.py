@@ -46,20 +46,75 @@ class Stage:
 
 
 class DebugServer:
-    """HTTP endpoint dumping the Stages this runner has seen (parity:
-    -debug-port, runner/handler.go:118-124)."""
+    """HTTP endpoint on the runner: Stage dumps (parity: -debug-port,
+    runner/handler.go:118-124) plus the cluster observability plane
+    (ISSUE 2) when the watcher carries a TelemetryAggregator:
+
+    - ``/cluster/metrics`` federated Prometheus exposition (peer labels)
+    - ``/cluster/trace``   cross-peer merged Chrome trace
+    - ``/cluster/health``  per-peer step rate / straggler JSON
+    - anything else        the Stage/worker debug dump (old contract)
+    """
 
     def __init__(self, watcher: "Watcher", port: int):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+        def cluster_view(path: str):
+            agg = getattr(watcher, "aggregator", None)
+            if agg is None:
+                return None
+            if path == "/cluster/metrics":
+                return agg.cluster_metrics(), "text/plain; version=0.0.4"
+            if path == "/cluster/trace":
+                return json.dumps(agg.cluster_trace()), "application/json"
+            if path == "/cluster/health":
+                return (
+                    json.dumps(agg.cluster_health(), indent=2),
+                    "application/json",
+                )
+            if path == "/cluster/audit":
+                return json.dumps(agg.cluster_audit()), "application/json"
+            return None
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):
                 pass
 
             def do_GET(inner):
-                body = json.dumps(watcher.debug_dump(), indent=2).encode()
+                from urllib.parse import urlsplit
+
+                # strip query/fragment before matching: a dashboard's
+                # cache-buster (?t=...) must not demote /cluster/health
+                # to the Stage dump
+                path = urlsplit(inner.path).path.rstrip("/")
+                try:
+                    if path.startswith("/cluster"):
+                        view = cluster_view(path)
+                        if view is None and getattr(
+                            watcher, "aggregator", None
+                        ) is not None:
+                            # unknown /cluster/* with a live plane: a
+                            # typo deserves a 404, not the wrong document
+                            inner.send_response(404)
+                            inner.end_headers()
+                            return
+                    else:
+                        view = None
+                    if view is not None:
+                        body_s, ctype = view
+                    else:
+                        body_s, ctype = (
+                            json.dumps(watcher.debug_dump(), indent=2),
+                            "application/json",
+                        )
+                except Exception as e:  # noqa: BLE001 - a broken view is a 500, not a crash
+                    inner.send_response(500)
+                    inner.end_headers()
+                    inner.wfile.write(str(e).encode())
+                    return
+                body = body_s.encode()
                 inner.send_response(200)
-                inner.send_header("Content-Type", "application/json")
+                inner.send_header("Content-Type", ctype)
                 inner.send_header("Content-Length", str(len(body)))
                 inner.end_headers()
                 inner.wfile.write(body)
@@ -134,6 +189,19 @@ class Watcher:
         self.auto_recover = bool(getattr(args, "auto_recover", ""))
         self.failure_restarts = 0
         self.last_stage: Optional[Stage] = None
+        # cluster observability plane (ISSUE 2): rides the -debug-port
+        # endpoint; scrapes every worker's /metrics|/trace|/audit and
+        # serves the merged /cluster/* views + straggler signals
+        self.aggregator = None
+        self.cluster_health_url = ""
+        if getattr(args, "debug_port", -1) >= 0:
+            from kungfu_tpu.telemetry.cluster import (
+                TelemetryAggregator,
+                set_aggregator,
+            )
+
+            self.aggregator = TelemetryAggregator()
+            set_aggregator(self.aggregator)
         self.hb_state = None
         self.monitor = None
         self.grace = 0.0
@@ -227,6 +295,12 @@ class Watcher:
             from kungfu_tpu.runner.monitored import MONITOR_ADDR_ENV
 
             p.env[MONITOR_ADDR_ENV] = f"{self.self_host}:{self.monitor.port}"
+        if self.cluster_health_url:
+            # workers poll this for the straggler/skew signals that feed
+            # PolicyContext.metrics (monitor.cluster_health)
+            from kungfu_tpu.telemetry.cluster import HEALTH_URL_ENV
+
+            p.env[HEALTH_URL_ENV] = self.cluster_health_url
         # standbys serve post-initial joins only (at t0 a cold spawn is
         # concurrent with everything else anyway, and the just-spawned
         # standbys may not have opened their FIFOs yet)
@@ -270,8 +344,17 @@ class Watcher:
         if self.hb_state is not None:
             self.hb_state.reset(stage.progress)
 
+    def _update_aggregator(self, stage: Stage) -> None:
+        """Point the scrape set at the new membership (the aggregator
+        learns the cluster from Stages, never from a static list)."""
+        if self.aggregator is not None:
+            self.aggregator.set_peers(
+                self.aggregator.targets_for_workers(stage.cluster.workers)
+            )
+
     def apply_delta(self, stage: Stage) -> None:
         self.last_stage = stage
+        self._update_aggregator(stage)
         self._reset_heartbeats(stage)
         new_local = {w for w in stage.cluster.workers if w.host == self.self_host}
         with self._state_lock:
@@ -287,6 +370,7 @@ class Watcher:
     def apply_full(self, stage: Stage) -> None:
         """Reload mode: stop everything, restart from stage.progress."""
         self.last_stage = stage
+        self._update_aggregator(stage)
         self._reset_heartbeats(stage)
         with self._state_lock:
             doomed = list(self.current.items())
@@ -406,6 +490,18 @@ class Watcher:
             debug = DebugServer(self, self.args.debug_port)
             debug.start()
             log.info("kfrun: debug endpoint on :%d", debug.port)
+        if self.aggregator is not None and debug is not None:
+            host = self.self_host or "127.0.0.1"
+            self.cluster_health_url = (
+                f"http://{host}:{debug.port}/cluster/health"
+            )
+            self._update_aggregator(initial)
+            self.aggregator.start()
+            log.info(
+                "kfrun: cluster telemetry: /cluster/{metrics,trace,health} "
+                "on :%d (scrape every %.1fs)",
+                debug.port, self.aggregator.interval,
+            )
         idle_since: Optional[float] = None
         try:
             self.apply_delta(initial)
@@ -502,6 +598,11 @@ class Watcher:
                 self.standby_pool.kill_all()
             if self.monitor is not None:
                 self.monitor.stop()
+            if self.aggregator is not None:
+                self.aggregator.stop()
+                from kungfu_tpu.telemetry.cluster import set_aggregator
+
+                set_aggregator(None)
             server.stop()
             if debug is not None:
                 debug.stop()

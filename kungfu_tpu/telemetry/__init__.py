@@ -14,6 +14,14 @@ the repo-wide replacement for bare ``print()``) and
 :mod:`~kungfu_tpu.telemetry.http` (the per-worker ``/metrics`` +
 ``/trace`` + ``/audit`` endpoint).
 
+The cluster plane (ISSUE 2) builds on those per-worker endpoints:
+:mod:`~kungfu_tpu.telemetry.cluster` is the runner-side aggregator
+(scrape, merge, ``/cluster/*`` views), with
+:mod:`~kungfu_tpu.telemetry.promparse` (exposition parsing/federation)
+and :mod:`~kungfu_tpu.telemetry.straggler` (robust skew detection)
+underneath — all lazily imported, since every worker imports this
+package on the transport path.
+
 Feature selection: ``KF_TELEMETRY=metrics,trace`` (see
 :mod:`~kungfu_tpu.telemetry.config`). ``dump()`` snapshots everything
 for ad-hoc inspection; see docs/telemetry.md for naming conventions.
@@ -51,7 +59,22 @@ __all__ = [
     "get_registry",
     "dump",
     "serve",
+    "cluster",
+    "promparse",
+    "straggler",
 ]
+
+_LAZY_MODULES = ("cluster", "promparse", "straggler")
+
+
+def __getattr__(name):
+    # the cluster plane is runner-side machinery; workers importing
+    # telemetry on the transport hot path must not pay for it
+    if name in _LAZY_MODULES:
+        import importlib
+
+        return importlib.import_module(f"kungfu_tpu.telemetry.{name}")
+    raise AttributeError(f"module {__name__!r} has no attribute {name!r}")
 
 
 def dump(prefix: str = "") -> dict:

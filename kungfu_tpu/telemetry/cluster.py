@@ -1,0 +1,810 @@
+"""Cluster observability plane: runner-side telemetry aggregation.
+
+ISSUE 2 tentpole. PR 1 gave every worker its own ``/metrics`` +
+``/trace`` + ``/audit`` endpoint on ``peer_port + 10000``; this module
+is the runner-side :class:`TelemetryAggregator` that periodically
+scrapes every live worker (it learns the cluster from the elastic
+watcher's Stages), merges the results into one cluster snapshot, and
+serves it from the watcher's debug endpoint:
+
+- ``/cluster/metrics`` — federated Prometheus exposition, every sample
+  labelled ``peer="host:port"`` (collisions become ``exported_peer``,
+  the Prometheus federation rule);
+- ``/cluster/trace``   — all workers' Chrome traces merged onto the
+  runner's timeline, per-peer clock offsets estimated NTP-style from
+  the scrape round trip (each response carries the worker's monotonic
+  clock in an ``X-KF-Perf-Now-Us`` header; offset error <= RTT/2, and
+  the stored offset only improves as lower-RTT scrapes land);
+- ``/cluster/health``  — JSON: per-peer step rate, step-time p50/p99,
+  bytes tx/rx, last-scrape age, straggler score/flag.
+
+On top of the snapshot the aggregator runs straggler detection
+(:mod:`~kungfu_tpu.telemetry.straggler`): rolling per-peer step-time
+medians, robust-z flagging of slow peers and RTT outliers. Flags are
+published three ways so every consumer sees the same truth:
+``kungfu_cluster_*`` gauges (the aggregator's own registry, appended to
+``/cluster/metrics``), ``telemetry.audit`` events on flag transitions,
+and adaptation-facing signals (``monitor.cluster_health()`` →
+``PolicyContext.metrics``) that let a ``BasePolicy`` trigger a resize
+or strategy switch on skew.
+"""
+
+from __future__ import annotations
+
+import json
+import math
+import os
+import threading
+import time
+import urllib.request
+from typing import Dict, List, Optional, Sequence, Tuple
+
+from kungfu_tpu.telemetry import audit, log, metrics, promparse
+from kungfu_tpu.telemetry.straggler import StragglerScorer
+
+# metric families scraped off each worker's exposition
+STEPS_TOTAL = "kungfu_steps_total"
+STEP_SECONDS = "kungfu_step_duration_seconds"
+COLLECTIVE_SECONDS = "kungfu_collective_latency_seconds"
+EGRESS_BYTES = "kungfu_egress_bytes_total"
+INGRESS_BYTES = "kungfu_ingress_bytes_total"
+PEER_RTT = "kungfu_peer_rtt_seconds"
+
+CLOCK_HEADER = "X-KF-Perf-Now-Us"
+
+DEFAULT_INTERVAL = 5.0
+INTERVAL_ENV = "KF_CLUSTER_SCRAPE_INTERVAL"
+HEALTH_URL_ENV = "KF_CLUSTER_HEALTH_URL"
+
+
+def scrape_interval() -> float:
+    try:
+        v = float(os.environ.get(INTERVAL_ENV, "") or DEFAULT_INTERVAL)
+        return v if v > 0 else DEFAULT_INTERVAL
+    except ValueError:
+        return DEFAULT_INTERVAL
+
+
+class _HistSnapshot:
+    """Cumulative histogram state parsed from one exposition page."""
+
+    __slots__ = ("bounds", "counts", "sum", "count")
+
+    def __init__(self, bounds, counts, total_sum, count):
+        self.bounds = bounds  # sorted finite bucket bounds
+        self.counts = counts  # cumulative counts aligned to bounds + [+Inf]
+        self.sum = total_sum
+        self.count = count
+
+    @classmethod
+    def from_samples(cls, samples, family) -> Optional["_HistSnapshot"]:
+        buckets = []
+        total_sum = total_count = None
+        for s in samples:
+            if s.name == family + "_bucket":
+                le = s.labels_dict().get("le", "")
+                bound = math.inf if le == "+Inf" else float(le)
+                buckets.append((bound, s.value))
+            elif s.name == family + "_sum":
+                total_sum = s.value
+            elif s.name == family + "_count":
+                total_count = s.value
+        if not buckets or total_count is None:
+            return None
+        buckets.sort(key=lambda b: b[0])
+        bounds = [b for b, _ in buckets if b != math.inf]
+        counts = [c for _, c in buckets]
+        return cls(bounds, counts, total_sum or 0.0, total_count)
+
+    def delta(self, prev: Optional["_HistSnapshot"]) -> "_HistSnapshot":
+        """Windowed histogram since `prev` (same buckets), or self."""
+        if (
+            prev is None
+            or prev.bounds != self.bounds
+            or prev.count > self.count  # worker restarted: counters reset
+        ):
+            return self
+        return _HistSnapshot(
+            self.bounds,
+            [c - p for c, p in zip(self.counts, prev.counts)],
+            self.sum - prev.sum,
+            self.count - prev.count,
+        )
+
+    def quantile(self, q: float) -> float:
+        """Interpolated quantile (histogram_quantile semantics)."""
+        total = self.counts[-1] if self.counts else 0
+        if total <= 0:
+            return math.nan
+        rank = q * total
+        prev_cum = 0.0
+        for i, cum in enumerate(self.counts):
+            if cum >= rank and cum > prev_cum:
+                lo = self.bounds[i - 1] if i > 0 else 0.0
+                hi = self.bounds[i] if i < len(self.bounds) else math.inf
+                if hi == math.inf:
+                    return lo
+                frac = (rank - prev_cum) / (cum - prev_cum)
+                return lo + (hi - lo) * frac
+            prev_cum = cum
+        return self.bounds[-1] if self.bounds else math.nan
+
+
+class PeerState:
+    """Everything the aggregator knows about one scrape target."""
+
+    def __init__(self, label: str, url: str):
+        self.label = label
+        self.url = url.rstrip("/")
+        self.last_ok: Optional[float] = None  # monotonic
+        self.last_error: str = ""
+        self.scrapes = 0
+        self.errors = 0
+        # a scrape thread is working this peer; the next sweep skips it
+        # rather than interleave prev/current swaps on the same state
+        self.inflight = False
+        self.rtt_s = math.inf  # last scrape round trip
+        self.best_rtt_s = math.inf
+        self.clock_offset_us: Optional[float] = None
+        self.metrics_text = ""
+        # step accounting across scrapes
+        self.steps_total: Optional[float] = None
+        self.step_hist: Optional[_HistSnapshot] = None
+        self.prev_steps: Optional[float] = None
+        self.prev_hist: Optional[_HistSnapshot] = None
+        self.prev_t: Optional[float] = None
+        self.step_rate: Optional[float] = None
+        self.step_p50: Optional[float] = None
+        self.step_p99: Optional[float] = None
+        # collective-wait accounting: in SYNCHRONOUS training every
+        # peer's wall-clock step converges to the straggler's (the fast
+        # peers spend the difference waiting inside collectives), so the
+        # straggler signal is compute time = step - collective wait
+        self.coll_sum: Optional[float] = None
+        self.coll_count: Optional[float] = None
+        self.prev_coll_sum: Optional[float] = None
+        self.compute_mean: Optional[float] = None
+        self.bytes_tx: Optional[float] = None
+        self.bytes_rx: Optional[float] = None
+        self.reported_rtt: Optional[float] = None  # median of its probes
+
+
+class TelemetryAggregator:
+    """Scrapes every worker's telemetry endpoint, keeps the merged
+    cluster snapshot, publishes straggler signals."""
+
+    def __init__(
+        self,
+        interval: Optional[float] = None,
+        timeout: float = 2.0,
+        registry: Optional[metrics.Registry] = None,
+        scorer: Optional[StragglerScorer] = None,
+        rtt_scorer: Optional[StragglerScorer] = None,
+    ):
+        self.interval = interval if interval is not None else scrape_interval()
+        self.timeout = timeout
+        self._peers: Dict[str, PeerState] = {}
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: Optional[threading.Thread] = None
+        self.scorer = scorer or StragglerScorer()
+        # RTT outliers: interconnect trouble shows up before step times
+        # do; a laxer z with a hard ratio floor suits the heavier tail
+        self.rtt_scorer = rtt_scorer or StragglerScorer(
+            window=8, z_threshold=3.0, ratio_threshold=2.0
+        )
+        self._flagged: set = set()
+        self._rtt_flagged: set = set()
+        self._scraped_at: Optional[float] = None  # wall time of last sweep
+        # a PRIVATE registry by default, not the process-global one: the
+        # runner's own transport metrics carry peer labels that mean "a
+        # remote peer of the runner" — mixing them into the federated
+        # page (where peer means "the scraped worker") would make the
+        # label ambiguous. /cluster/metrics appends this exposition.
+        reg = registry if registry is not None else metrics.Registry()
+        self.registry = reg
+        self._g_step_rate = reg.gauge(
+            "kungfu_cluster_step_rate",
+            "Steps/sec per peer, from scrape-to-scrape deltas",
+            ("peer",),
+        )
+        self._g_step_time = reg.gauge(
+            "kungfu_cluster_step_time_seconds",
+            "Windowed step-time quantiles per peer",
+            ("peer", "quantile"),
+        )
+        self._g_score = reg.gauge(
+            "kungfu_cluster_straggler_score",
+            "Robust z-score of each peer's step time vs the cluster median",
+            ("peer",),
+        )
+        self._g_stragglers = reg.gauge(
+            "kungfu_cluster_stragglers",
+            "Number of peers currently flagged as stragglers",
+        )
+        self._g_age = reg.gauge(
+            "kungfu_cluster_scrape_age_seconds",
+            "Seconds since the last successful scrape per peer",
+            ("peer",),
+        )
+        self._c_scrapes = reg.counter(
+            "kungfu_cluster_scrapes_total",
+            "Aggregator scrape sweeps completed",
+        )
+        self._c_errors = reg.counter(
+            "kungfu_cluster_scrape_errors_total",
+            "Failed peer scrapes",
+            ("peer",),
+        )
+
+    # -- membership ----------------------------------------------------
+    @staticmethod
+    def targets_for_workers(workers) -> List[Tuple[str, str]]:
+        """PeerIDs -> (label, telemetry base URL) on peer_port+10000."""
+        out = []
+        for w in workers:
+            port = w.port + 10000
+            if port > 65535:
+                # mirror of the worker-side OverflowError guard in
+                # peer.py — but say so: an invisible peer can never be
+                # flagged, and a silent skip reads as a healthy cluster
+                log.warn(
+                    "cluster: %s has no telemetry port (peer_port+10000 "
+                    "> 65535); excluded from the cluster plane", w,
+                )
+                continue
+            out.append((str(w), f"http://{w.host}:{port}"))
+        return out
+
+    def set_peers(self, targets: Sequence[Tuple[str, str]]) -> None:
+        """Replace the scrape set (the watcher calls this on every
+        Stage). Surviving peers keep their scrape history and clock
+        offsets; departed peers drop out of the scorers so they can't
+        skew the population as ghosts."""
+        with self._lock:
+            fresh: Dict[str, PeerState] = {}
+            for label, url in targets:
+                st = self._peers.get(label)
+                if st is None or st.url != url.rstrip("/"):
+                    st = PeerState(label, url)
+                fresh[label] = st
+            self._peers = fresh
+        live = list(fresh)
+        self.scorer.forget(live)
+        self.rtt_scorer.forget(live)
+        self._flagged &= set(live)
+        self._rtt_flagged &= set(live)
+        # per-peer gauge children follow the membership (bounded
+        # cardinality across elastic resizes)
+        for g in (self._g_step_rate, self._g_step_time, self._g_score,
+                  self._g_age):
+            g.clear_children()
+
+    def peers(self) -> List[PeerState]:
+        with self._lock:
+            return list(self._peers.values())
+
+    # -- scraping ------------------------------------------------------
+    def _fetch(
+        self, st: PeerState, path: str, record_rtt: bool = True
+    ) -> Tuple[bytes, dict]:
+        """GET one peer endpoint. record_rtt=False for the on-demand
+        trace/audit pulls: their multi-MB bodies measure transfer time,
+        not the network — writing that into rtt_s would paint a phantom
+        'network problem' in /cluster/health whenever someone looks at
+        traces (the clock-offset update stays safe either way: it only
+        accepts estimates that BEAT the best RTT seen)."""
+        t0 = time.perf_counter()
+        with urllib.request.urlopen(st.url + path, timeout=self.timeout) as r:
+            body = r.read()
+            clock = r.headers.get(CLOCK_HEADER)
+        t1 = time.perf_counter()
+        rtt = t1 - t0
+        if record_rtt:
+            st.rtt_s = rtt
+        if clock is not None:
+            # NTP midpoint: assume the worker stamped the header halfway
+            # through the round trip. perf_counter epochs are fixed per
+            # process, so the TRUE offset is constant — keep the estimate
+            # from the lowest-RTT scrape ever seen (its error bound,
+            # RTT/2, is the tightest)
+            if rtt <= st.best_rtt_s or st.clock_offset_us is None:
+                st.best_rtt_s = rtt
+                mid_us = (t0 + t1) / 2.0 * 1e6
+                try:
+                    st.clock_offset_us = mid_us - float(clock)
+                except ValueError:
+                    pass
+        return body, {"rtt_s": rtt}
+
+    def _scrape_peer(self, st: PeerState) -> None:
+        now = time.monotonic()
+        try:
+            body, _ = self._fetch(st, "/metrics")
+        except (OSError, ValueError) as e:
+            st.last_error = str(e)
+            st.errors += 1
+            self._c_errors.labels(st.label).inc()
+            # a peer that stopped answering must not keep serving its
+            # last-known-healthy numbers: a dashboard or policy reading
+            # step_rate would see a live peer hours after it died. The
+            # delta baselines reset too, so a comeback doesn't compute a
+            # rate smeared across the outage — and its SCORER series
+            # goes with it: a frozen window would keep the peer flagged
+            # (or keep skewing the population) off hours-old data, and
+            # straggler_cleared would never fire. The window rebuilds
+            # within min_samples scrapes if the endpoint comes back.
+            st.step_rate = st.step_p50 = st.step_p99 = None
+            st.compute_mean = None
+            st.prev_steps = st.prev_t = None
+            st.prev_hist = None
+            st.prev_coll_sum = None
+            # the CUMULATIVE snapshots go too, not just the prev_*
+            # baselines: the success path copies current into prev_*
+            # before overwriting, so a surviving pre-outage snapshot
+            # would become the baseline for a possibly-restarted worker
+            # — cross-epoch deltas (negative buckets, garbage quantiles)
+            # once the new epoch's counts pass the old ones
+            st.steps_total = None
+            st.step_hist = None
+            st.coll_sum = None
+            # the frozen exposition page goes too: cluster_metrics()
+            # federates whatever is stored, and a dead peer's last page
+            # would keep it looking alive on the Prometheus view
+            st.metrics_text = ""
+            self.scorer.drop(st.label)
+            self.rtt_scorer.drop(st.label)
+            return
+        st.scrapes += 1
+        st.last_ok = now
+        st.last_error = ""
+        st.metrics_text = body.decode(errors="replace")
+        samples = promparse.parse_text(st.metrics_text)
+        st.prev_steps, st.prev_hist = st.steps_total, st.step_hist
+        st.prev_coll_sum = st.coll_sum
+        st.steps_total = promparse.sample_value(samples, STEPS_TOTAL)
+        st.step_hist = _HistSnapshot.from_samples(samples, STEP_SECONDS)
+        tx = rx = None
+        coll_sum = None
+        rtts = []
+        for s in samples:
+            if s.name == EGRESS_BYTES:
+                tx = (tx or 0.0) + s.value
+            elif s.name == INGRESS_BYTES:
+                rx = (rx or 0.0) + s.value
+            elif s.name == COLLECTIVE_SECONDS + "_sum":
+                # summed across the per-kind label children: total
+                # seconds this worker has spent inside host collectives
+                coll_sum = (coll_sum or 0.0) + s.value
+            elif s.name == PEER_RTT and math.isfinite(s.value) and s.value > 0:
+                rtts.append(s.value)
+        st.coll_sum = coll_sum
+        st.bytes_tx, st.bytes_rx = tx, rx
+        st.reported_rtt = sorted(rtts)[len(rtts) // 2] if rtts else None
+        # step rate + windowed quantiles from scrape-to-scrape deltas
+        if (
+            st.steps_total is not None
+            and st.prev_steps is not None
+            and st.prev_t is not None
+            and now > st.prev_t
+            and st.steps_total >= st.prev_steps  # restart resets to 0
+        ):
+            st.step_rate = (st.steps_total - st.prev_steps) / (now - st.prev_t)
+        st.prev_t = now
+        if st.step_hist is not None:
+            window = st.step_hist.delta(st.prev_hist)
+            if window.count > 0:
+                st.step_p50 = window.quantile(0.50)
+                st.step_p99 = window.quantile(0.99)
+                step_mean = window.sum / window.count
+                # score COMPUTE time (step minus collective wait) when
+                # the worker publishes collective latencies: under
+                # synchronous training wall-clock step times converge to
+                # the slowest peer's, and the straggler is the one whose
+                # time went to compute instead of waiting
+                compute = step_mean
+                if (
+                    st.coll_sum is not None
+                    and st.prev_coll_sum is not None
+                    and st.coll_sum >= st.prev_coll_sum  # restart guard
+                ):
+                    wait = (st.coll_sum - st.prev_coll_sum) / window.count
+                    compute = max(step_mean - wait, 0.0)
+                st.compute_mean = compute
+                self.scorer.observe(st.label, compute)
+        # outlier scoring uses ONLY the worker-published probe RTTs
+        # (kungfu_peer_rtt_seconds): the HTTP scrape duration measures
+        # TCP setup + body transfer, an order of magnitude above a probe
+        # RTT — mixing the two in one population would flag any peer
+        # that simply hasn't probed yet. The scrape RTT stays visible in
+        # health as rtt_ms, it just doesn't vote.
+        if st.reported_rtt is not None:
+            self.rtt_scorer.observe(st.label, st.reported_rtt)
+
+    def scrape_once(self) -> dict:
+        """One sweep over every target (parallel, bounded by the HTTP
+        timeout), then re-score stragglers and publish. Returns the
+        fresh health snapshot. A peer whose previous scrape thread is
+        still in flight (a server dripping bytes under the timeout) is
+        skipped this sweep — two threads swapping the same peer's
+        prev/current baselines would corrupt its rates."""
+
+        def scrape_and_clear(st: PeerState) -> None:
+            try:
+                self._scrape_peer(st)
+            finally:
+                st.inflight = False
+
+        threads = []
+        for st in self.peers():
+            if st.inflight:
+                continue
+            st.inflight = True
+            threads.append(
+                threading.Thread(
+                    target=scrape_and_clear, args=(st,), daemon=True
+                )
+            )
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.timeout + 1.0)
+        self._c_scrapes.inc()
+        self._scraped_at = time.time()
+        self._publish()
+        return self.cluster_health()
+
+    def _publish(self) -> None:
+        scores = self.scorer.scores()
+        rtt_scores = self.rtt_scorer.scores()
+        flagged = {p for p, s in scores.items() if s.flagged}
+        rtt_flagged = {p for p, s in rtt_scores.items() if s.flagged}
+        cluster_median = self.scorer.cluster_median()
+        # rebuild the per-peer gauge children every sweep: set() without
+        # a clear would leave a dead peer's last-known-healthy values
+        # frozen in the exposition forever (the JSON view nulls them,
+        # and the metrics view must agree)
+        for g in (self._g_step_rate, self._g_step_time, self._g_score):
+            g.clear_children()
+        for st in self.peers():
+            if st.step_rate is not None:
+                self._g_step_rate.labels(st.label).set(st.step_rate)
+            if st.step_p50 is not None:
+                self._g_step_time.labels(st.label, "0.5").set(st.step_p50)
+            if st.step_p99 is not None:
+                self._g_step_time.labels(st.label, "0.99").set(st.step_p99)
+            sc = scores.get(st.label)
+            if sc is not None:
+                self._g_score.labels(st.label).set(sc.score)
+            if st.last_ok is not None:
+                self._g_age.labels(st.label).set(
+                    time.monotonic() - st.last_ok
+                )
+        self._g_stragglers.set(len(flagged))
+        # audit on TRANSITIONS only: the log answers "when did peer X
+        # become slow", not "is it still slow every 5 seconds"
+        for peer in sorted(flagged - self._flagged):
+            sc = scores[peer]
+            log.warn(
+                "cluster: straggler detected: %s step_time=%.1fms "
+                "(cluster median %.1fms, z=%.1f)",
+                peer, sc.value * 1e3, (cluster_median or 0) * 1e3, sc.score,
+            )
+            audit.record_event(
+                "straggler",
+                peer=peer,
+                trigger="cluster_scrape",
+                score=round(sc.score, 2),
+                step_time_ms=round(sc.value * 1e3, 3),
+                cluster_median_ms=round((cluster_median or 0) * 1e3, 3),
+            )
+        for peer in sorted(self._flagged - flagged):
+            audit.record_event(
+                "straggler_cleared", peer=peer, trigger="cluster_scrape"
+            )
+        for peer in sorted(rtt_flagged - self._rtt_flagged):
+            sc = rtt_scores[peer]
+            audit.record_event(
+                "rtt_outlier",
+                peer=peer,
+                trigger="cluster_scrape",
+                score=round(sc.score, 2),
+                rtt_ms=round(sc.value * 1e3, 3),
+            )
+        self._flagged = flagged
+        self._rtt_flagged = rtt_flagged
+
+    # -- background loop -----------------------------------------------
+    def start(self) -> None:
+        if self._thread is not None:
+            return
+        self._stop.clear()
+
+        def loop():
+            while not self._stop.wait(self.interval):
+                try:
+                    self.scrape_once()
+                except Exception as e:  # noqa: BLE001 - the plane must outlive a bad sweep
+                    log.warn("cluster: scrape sweep failed: %s", e)
+
+        self._thread = threading.Thread(
+            target=loop, name="kf-cluster-scrape", daemon=True
+        )
+        self._thread.start()
+
+    def stop(self) -> None:
+        self._stop.set()
+        t, self._thread = self._thread, None
+        if t is not None:
+            t.join(self.timeout + 1.0)
+
+    # -- merged views ---------------------------------------------------
+    def cluster_metrics(self) -> str:
+        """Federated exposition of every worker's last-scraped /metrics,
+        plus the aggregator's own registry (the kungfu_cluster_* gauges
+        and scrape counters — already peer-labelled, no injection) so
+        one Prometheus target sees the whole plane."""
+        pages: List[Tuple[Optional[str], str]] = [
+            (st.label, st.metrics_text)
+            for st in self.peers()
+            if st.metrics_text
+        ]
+        pages.append((None, self.registry.render()))
+        return promparse.merge_expositions(pages)
+
+    def _fetch_all(self, path: str) -> List[Tuple["PeerState", bytes]]:
+        """Parallel fetch of one endpoint from every peer (the serial
+        version made /cluster/trace block for N x timeout with a few
+        unreachable workers — at exactly the moment an operator is
+        debugging a sick cluster). Failures record last_error and drop
+        out of the result."""
+        targets = sorted(self.peers(), key=lambda s: s.label)
+        results: List[Optional[bytes]] = [None] * len(targets)
+
+        def one(i: int, st: PeerState) -> None:
+            try:
+                body, _ = self._fetch(st, path, record_rtt=False)
+                results[i] = body
+            except (OSError, ValueError) as e:
+                st.last_error = str(e)
+
+        threads = [
+            threading.Thread(target=one, args=(i, st), daemon=True)
+            for i, st in enumerate(targets)
+        ]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(self.timeout + 1.0)
+        return [
+            (st, body) for st, body in zip(targets, results) if body is not None
+        ]
+
+    def cluster_trace(self) -> dict:
+        """Live-fetch every worker's /trace and merge onto the runner's
+        monotonic timeline: each peer becomes a Chrome-trace process
+        (pid = peer index, process_name metadata), and its timestamps
+        shift by the estimated clock offset so cross-peer causality
+        (e.g. "every peer's allreduce stalls when peer 3 is late") is
+        visible in one view."""
+        merged: List[dict] = []
+        for idx, (st, body) in enumerate(self._fetch_all("/trace")):
+            try:
+                doc = json.loads(body.decode())
+            except ValueError as e:
+                st.last_error = str(e)
+                continue
+            offset = st.clock_offset_us or 0.0
+            merged.append({
+                "name": "process_name", "ph": "M", "pid": idx, "tid": 0,
+                "args": {"name": st.label},
+            })
+            merged.append({
+                "name": "process_sort_index", "ph": "M", "pid": idx,
+                "tid": 0, "args": {"sort_index": idx},
+            })
+            for ev in doc.get("traceEvents", []):
+                ev = dict(ev)
+                ev["pid"] = idx
+                if isinstance(ev.get("ts"), (int, float)):
+                    ev["ts"] = ev["ts"] + offset
+                merged.append(ev)
+        return {"traceEvents": merged, "displayTimeUnit": "ms"}
+
+    def cluster_audit(self) -> List[dict]:
+        """Merged audit timeline: every worker's /audit plus the
+        runner's own records, sorted by wall time."""
+        records = list(audit.to_json())
+        for st, body in self._fetch_all("/audit"):
+            try:
+                peer_records = json.loads(body.decode())
+            except ValueError:
+                continue
+            for rec in peer_records:
+                rec = dict(rec)
+                rec.setdefault("peer", st.label)
+                records.append(rec)
+        records.sort(key=lambda r: r.get("wall_time", 0.0))
+        return records
+
+    def cluster_health(self) -> dict:
+        """The JSON health snapshot behind /cluster/health and
+        monitor.cluster_health()."""
+        now = time.monotonic()
+        scores = self.scorer.scores()
+        rtt_scores = self.rtt_scorer.scores()
+        peers = {}
+        for st in self.peers():
+            sc = scores.get(st.label)
+            rsc = rtt_scores.get(st.label)
+            peers[st.label] = {
+                "url": st.url,
+                "step_rate": st.step_rate,
+                "step_time_p50_ms": (
+                    round(st.step_p50 * 1e3, 3) if st.step_p50 is not None
+                    else None
+                ),
+                "step_time_p99_ms": (
+                    round(st.step_p99 * 1e3, 3) if st.step_p99 is not None
+                    else None
+                ),
+                # the SCORED series' rolling median: compute time (step
+                # minus collective wait) when the worker publishes
+                # collective latencies, else wall-clock step time
+                "step_time_ms": (
+                    round(sc.value * 1e3, 3) if sc is not None else None
+                ),
+                "compute_time_ms": (
+                    round(st.compute_mean * 1e3, 3)
+                    if st.compute_mean is not None else None
+                ),
+                "bytes_tx": st.bytes_tx,
+                "bytes_rx": st.bytes_rx,
+                "rtt_ms": (
+                    round(st.rtt_s * 1e3, 3)
+                    if math.isfinite(st.rtt_s) else None
+                ),
+                "clock_offset_us": st.clock_offset_us,
+                "last_scrape_age_s": (
+                    round(now - st.last_ok, 3)
+                    if st.last_ok is not None else None
+                ),
+                "error": st.last_error or None,
+                "straggler": bool(sc.flagged) if sc is not None else False,
+                "straggler_score": (
+                    round(sc.score, 2) if sc is not None else None
+                ),
+                "rtt_outlier": bool(rsc.flagged) if rsc is not None else False,
+            }
+        med = self.scorer.cluster_median()
+        return {
+            # wall_time is the LAST SCRAPE's stamp, not request time:
+            # consumers debounce refreshes on it (cluster/updated_at),
+            # so re-reading an unchanged snapshot must not look fresh
+            "wall_time": self._scraped_at,
+            "interval_s": self.interval,
+            "peers": peers,
+            "stragglers": sorted(self._flagged),
+            "rtt_outliers": sorted(self._rtt_flagged),
+            "cluster_step_time_ms": (
+                round(med * 1e3, 3) if med is not None else None
+            ),
+            "step_skew": self.scorer.skew(),
+        }
+
+
+# -- adaptation-facing accessors ---------------------------------------
+
+_aggregator: Optional[TelemetryAggregator] = None
+_agg_lock = threading.Lock()
+# remote /cluster/health cache: "t" = monotonic time of the last
+# SUCCESSFUL fetch (a failed refresh must NOT re-stamp stale flags as
+# fresh), "attempt_t" rate-limits refresh attempts, "fetching" holds the
+# single in-flight refresh thread flag
+_remote_cache: dict = {
+    "t": 0.0, "attempt_t": 0.0, "data": None, "url": "", "fetching": False,
+}
+
+
+def set_aggregator(agg: Optional[TelemetryAggregator]) -> None:
+    """Install the process-wide aggregator (the elastic watcher does
+    this; tests may too)."""
+    global _aggregator
+    with _agg_lock:
+        _aggregator = agg
+
+
+def get_aggregator() -> Optional[TelemetryAggregator]:
+    with _agg_lock:
+        return _aggregator
+
+
+def _refresh_remote(url: str) -> None:
+    try:
+        with urllib.request.urlopen(url, timeout=2.0) as r:
+            data = json.loads(r.read().decode())
+        with _agg_lock:
+            if _remote_cache["url"] == url:
+                _remote_cache.update(t=time.monotonic(), data=data)
+    except (OSError, ValueError):
+        pass  # keep the old data AND its old timestamp: stale is stale
+    finally:
+        with _agg_lock:
+            _remote_cache["fetching"] = False
+
+
+def health_snapshot(max_age: float = 5.0, wait: bool = False) -> Optional[dict]:
+    """The latest cluster-health dict, from the in-process aggregator
+    when this process hosts one (the runner), else fetched from
+    ``KF_CLUSTER_HEALTH_URL`` (workers; the watcher injects the env var
+    pointing at its own /cluster/health).
+
+    The remote path NEVER blocks the caller (it sits on the training-step
+    path via PolicyRunner): it returns the cached snapshot immediately —
+    possibly stale, possibly None on the very first call — and refreshes
+    in a background thread at most every ``max_age`` seconds. A snapshot
+    older than the last scrape keeps its original ``wall_time``, so
+    debounced consumers (cluster/updated_at) never mistake a dead
+    runner's last flags for news. ``wait=True`` (tests, one-shot CLIs)
+    runs an overdue refresh inline instead."""
+    agg = get_aggregator()
+    if agg is not None:
+        return agg.cluster_health()
+    url = os.environ.get(HEALTH_URL_ENV, "")
+    if not url:
+        return None
+    now = time.monotonic()
+    with _agg_lock:
+        if _remote_cache["url"] != url:
+            _remote_cache.update(
+                t=0.0, attempt_t=0.0, data=None, url=url, fetching=False
+            )
+        data = _remote_cache["data"]
+        fresh = data is not None and now - _remote_cache["t"] < max_age
+        due = (
+            not fresh
+            and not _remote_cache["fetching"]
+            and now - _remote_cache["attempt_t"] >= max_age
+        )
+        if due:
+            _remote_cache["fetching"] = True
+            _remote_cache["attempt_t"] = now
+    if due:
+        if wait:
+            _refresh_remote(url)
+            with _agg_lock:
+                return _remote_cache["data"]
+        threading.Thread(
+            target=_refresh_remote, args=(url,),
+            name="kf-health-refresh", daemon=True,
+        ).start()
+    return data
+
+
+def health_signals(
+    max_age: float = 5.0, self_peer: str = "", wait: bool = False
+) -> dict:
+    """Flatten the health snapshot into the signal dict policies see in
+    ``PolicyContext.metrics`` (namespaced ``cluster/``)."""
+    snap = health_snapshot(max_age, wait=wait)
+    if not snap:
+        return {}
+    me = self_peer or os.environ.get("KF_SELF_SPEC", "")
+    stragglers = snap.get("stragglers", [])
+    signals = {
+        # refresh marker: consumers that must count SCRAPES (not steps)
+        # key off this — flag lists are identical between refreshes for
+        # a steady straggler
+        "cluster/updated_at": snap.get("wall_time"),
+        "cluster/stragglers": stragglers,
+        "cluster/rtt_outliers": snap.get("rtt_outliers", []),
+        "cluster/step_skew": snap.get("step_skew"),
+        "cluster/step_time_ms": snap.get("cluster_step_time_ms"),
+        "cluster/straggler_score": {
+            p: info.get("straggler_score")
+            for p, info in snap.get("peers", {}).items()
+            if info.get("straggler_score") is not None
+        },
+        "cluster/self_straggler": me in stragglers if me else False,
+    }
+    return signals

@@ -20,10 +20,18 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Callable, Dict, Optional
 
 from kungfu_tpu.telemetry import audit, metrics, tracing
+
+# every response carries this process's monotonic clock (perf_counter
+# microseconds — the span tracer's timebase) so a scraper can estimate
+# the clock offset NTP-style from its request round trip and merge
+# traces from many workers onto one timeline
+CLOCK_HEADER = "X-KF-Perf-Now-Us"
+WALL_HEADER = "X-KF-Wall-Time-S"
 
 
 class TelemetryServer:
@@ -54,7 +62,12 @@ class TelemetryServer:
                 pass
 
             def do_GET(inner):
-                route = routes.get(inner.path.rstrip("/") or "/metrics")
+                from urllib.parse import urlsplit
+
+                # query/fragment never select the route: a scraper's
+                # cache-buster (/metrics?t=...) must hit /metrics
+                path = urlsplit(inner.path).path.rstrip("/")
+                route = routes.get(path or "/metrics")
                 if route is None:
                     inner.send_response(404)
                     inner.end_headers()
@@ -70,6 +83,8 @@ class TelemetryServer:
                 inner.send_response(200)
                 inner.send_header("Content-Type", ctype)
                 inner.send_header("Content-Length", str(len(body)))
+                inner.send_header(CLOCK_HEADER, repr(time.perf_counter() * 1e6))
+                inner.send_header(WALL_HEADER, repr(time.time()))
                 inner.end_headers()
                 inner.wfile.write(body)
 

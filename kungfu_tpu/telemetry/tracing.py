@@ -175,7 +175,17 @@ def chrome_trace(prefix: str = "") -> dict:
         args["depth"] = e.depth
         ev["args"] = args
         trace_events.append(ev)
-    return {"traceEvents": trace_events, "displayTimeUnit": "ms"}
+    return {
+        "traceEvents": trace_events,
+        "displayTimeUnit": "ms",
+        # clock anchors for offline cross-process merges: ts values are
+        # perf_counter us, rendered at perf_now_us == wall_time_s
+        "metadata": {
+            "pid": pid,
+            "perf_now_us": time.perf_counter() * 1e6,
+            "wall_time_s": time.time(),
+        },
+    }
 
 
 def chrome_trace_json(prefix: str = "") -> str:

@@ -13,6 +13,7 @@ callers must guard on `available`.
 from __future__ import annotations
 
 import ctypes
+import errno
 import os
 import socket
 
@@ -50,7 +51,17 @@ except (OSError, AttributeError):
 
 def _timeout_ms(sock: socket.socket) -> int:
     t = sock.gettimeout()
-    return -1 if t is None else max(1, int(t * 1000))
+    if t is None:
+        return -1  # blocking: the pump polls without deadline
+    if t == 0:
+        # non-blocking socket: keep it non-blocking. The pump attempts
+        # the syscall once and its poll() deadline expires immediately
+        # on EAGAIN; _check maps that to BlockingIOError, matching
+        # Python socket semantics. (This used to round up to a 1 ms
+        # blocking poll — silently turning a non-blocking socket into a
+        # blocking one.)
+        return 0
+    return max(1, int(t * 1000))
 
 
 def _as_arg(data):
@@ -70,12 +81,24 @@ def _as_arg(data):
     return ctypes.c_char_p(obj), n
 
 
-def _check(rc: int, what: str) -> None:
+def _check(rc: int, what: str, timeout_ms: int) -> None:
     if rc == 0:
         return
     if rc == -1:
         raise ConnectionError(f"peer closed connection during {what}")
     if rc == -2:
+        if timeout_ms == 0:
+            # non-blocking socket, no progress possible right now: the
+            # caller asked not to wait, so raise what a non-blocking
+            # Python socket would. UNLIKE a single non-blocking
+            # recv/send, these are multi-byte LOOPS: a partial frame may
+            # already be on the wire (send) or consumed into the buffer
+            # (recv) — framed-protocol callers must treat this exactly
+            # like a timeout, i.e. a connection-level failure, never a
+            # retry-the-same-call signal.
+            raise BlockingIOError(
+                errno.EAGAIN, f"{what} would block (non-blocking socket)"
+            )
         raise socket.timeout(f"timed out during {what}")
     raise OSError(-rc, f"{what}: {os.strerror(-rc)}")
 
@@ -83,15 +106,18 @@ def _check(rc: int, what: str) -> None:
 def send2(sock: socket.socket, head: bytes, payload, payload_nbytes: int) -> None:
     """One writev-looped send of [head | payload], GIL released."""
     pbuf, pn = (_as_arg(payload) if payload_nbytes else (None, 0))
-    rc = _lib.kf_send2(
-        sock.fileno(), head, len(head), pbuf, pn, _timeout_ms(sock)
-    )
-    _check(rc, "send")
+    t_ms = _timeout_ms(sock)
+    rc = _lib.kf_send2(sock.fileno(), head, len(head), pbuf, pn, t_ms)
+    _check(rc, "send", t_ms)
 
 
 def recv_exact_into(sock: socket.socket, view: memoryview) -> None:
     """Receive exactly len(view) bytes into the writable view, GIL
-    released."""
+    released. On timeout/BlockingIOError a PREFIX of the view may
+    already be filled (bytes consumed off the socket) — the stream
+    position is indeterminate, so treat either as fatal for the
+    connection, not as retryable."""
     buf, n = _as_arg(view)
-    rc = _lib.kf_recv_exact(sock.fileno(), buf, n, _timeout_ms(sock))
-    _check(rc, "recv")
+    t_ms = _timeout_ms(sock)
+    rc = _lib.kf_recv_exact(sock.fileno(), buf, n, t_ms)
+    _check(rc, "recv", t_ms)

@@ -93,7 +93,8 @@ class Client:
                     pass
             self._pool.clear()
             for arena in self._arenas.values():
-                arena.close()
+                if arena is not None:
+                    arena.close()
             self._arenas.clear()
 
     def _colocated(self, peer: PeerID) -> bool:
@@ -105,18 +106,32 @@ class Client:
         )
 
     def _fresh_arena(self, key: Tuple[PeerID, ConnType]):
-        """(Re)create the sender arena for a freshly-made connection."""
+        """(Re)create the sender arena for a freshly-made connection.
+        A full tmpfs (ArenaSpaceError from posix_fallocate) degrades the
+        connection to plain socket frames for this epoch — slower, still
+        correct — instead of a SIGBUS on the first ring write; the next
+        reconnect/resize retries. None in the table records the
+        degradation (vs. absent = not attempted yet)."""
         old = self._arenas.pop(key, None)
         if old is not None:
             old.close()
         peer, conn_type = key
-        arena = shm.SenderArena(
-            shm.arena_path(
-                peer.host, peer.port,
-                self.self_id.host, self.self_id.port,
-                int(conn_type),
+        try:
+            arena = shm.SenderArena(
+                shm.arena_path(
+                    peer.host, peer.port,
+                    self.self_id.host, self.self_id.port,
+                    int(conn_type),
+                )
             )
-        )
+        except shm.ArenaSpaceError as e:
+            trace.record("transport.shm_alloc_fail", 0.0)
+            shm.count_alloc_failure()
+            from kungfu_tpu.telemetry import log as _log
+
+            _log.warn("shm arena unavailable, using sockets to %s: %s", peer, e)
+            self._arenas[key] = None
+            return None
         self._arenas[key] = arena
         return arena
 
@@ -180,9 +195,12 @@ class Client:
             ring falls back to the socket frame (kernel flow control)."""
             if not use_shm:
                 return Message(name=name, data=data, flags=flags)
-            arena = self._arenas.get(key)
-            if arena is None:
+            if key in self._arenas:
+                arena = self._arenas[key]
+            else:
                 arena = self._fresh_arena(key)
+            if arena is None:  # degraded: tmpfs couldn't back the ring
+                return Message(name=name, data=data, flags=flags)
             desc = arena.try_write(data, data_len)
             if desc is None:
                 return Message(name=name, data=data, flags=flags)

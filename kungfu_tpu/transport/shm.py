@@ -65,6 +65,31 @@ def enabled() -> bool:
     )
 
 
+class ArenaSpaceError(OSError):
+    """tmpfs can't back the arena (ENOSPC at creation). ftruncate alone
+    only reserves address space — without an upfront allocation the
+    first write into an unbacked page on a full /dev/shm is a SIGBUS
+    that kills the worker mid-collective. Raised at creation so the
+    sender can degrade to the socket path instead."""
+
+
+def count_alloc_failure() -> None:
+    """Count an arena-allocation failure (its own series, NOT
+    kungfu_shm_fallback_total: that counter means "the receiver is
+    behind" — an operator watching the fallback share to diagnose a
+    chronically-slow receiver must not see a full /dev/shm in it)."""
+    from kungfu_tpu.telemetry import config as _tcfg
+
+    if _tcfg.metrics_enabled():
+        from kungfu_tpu.telemetry import metrics as _tm
+
+        _tm.counter(
+            "kungfu_shm_alloc_failures_total",
+            "Arena allocations refused (tmpfs full); connection degraded "
+            "to socket frames",
+        ).inc()
+
+
 def arena_path(
     recv_host: str, recv_port: int, send_host: str, send_port: int, conn_type: int
 ) -> str:
@@ -108,7 +133,27 @@ class SenderArena:
         fd = os.open(path, os.O_CREAT | os.O_EXCL | os.O_RDWR, 0o600)
         try:
             os.ftruncate(fd, HEADER + capacity)
+            # back every page NOW: ftruncate only sizes the file, and on
+            # a full tmpfs the first store into an unbacked page is a
+            # SIGBUS (uncatchable worker death). posix_fallocate turns
+            # "tmpfs is full" into an ENOSPC here, which the client
+            # degrades to the socket path (graceful, counted).
+            if hasattr(os, "posix_fallocate"):
+                try:
+                    os.posix_fallocate(fd, 0, HEADER + capacity)
+                except OSError as e:
+                    raise ArenaSpaceError(
+                        e.errno or 0,
+                        f"cannot back shm arena {path} "
+                        f"({(HEADER + capacity) >> 20} MiB): {e.strerror}",
+                    ) from e
             self._mm = mmap.mmap(fd, HEADER + capacity)
+        except ArenaSpaceError:
+            try:
+                os.unlink(path)
+            except OSError:
+                pass
+            raise
         finally:
             os.close(fd)
         self._seq = np.frombuffer(self._mm, np.uint64, 2, offset=16)

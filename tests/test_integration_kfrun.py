@@ -121,6 +121,19 @@ def test_kfrun_debug_port_dumps_stages():
         assert dump and dump["stages"] and dump["stages"][0]["version"] == 0
         assert len(dump["stages"][0]["workers"]) == 2
         assert len(dump["workers"]) == 2, dump
+        # ISSUE 2: the same endpoint serves the cluster plane; the
+        # aggregator tracks every worker from the Stage (these sleep(8)
+        # workers run no telemetry server, so scrapes error — but the
+        # membership and health shape must be there)
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/cluster/health", timeout=5
+        ) as r:
+            health = json.loads(r.read().decode())
+        assert set(health["peers"]) == set(dump["workers"])
+        with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}/cluster/metrics", timeout=5
+        ) as r:
+            assert r.status == 200
     finally:
         p.kill()
         p.wait(10)

@@ -107,6 +107,78 @@ def test_metrics_endpoint():
         srv.stop()
 
 
+def test_native_io_nonblocking_socket_explicit():
+    """ISSUE 2 satellite: a non-blocking socket (timeout 0) must stay
+    non-blocking through the native pump — BlockingIOError when no
+    progress is possible, not a silent 1 ms blocking poll."""
+    import socket
+
+    from kungfu_tpu.transport import _native_io
+
+    if not _native_io.available:
+        pytest.skip("libkfnative not built")
+    a, b = socket.socketpair()
+    try:
+        a.setblocking(False)
+        assert _native_io._timeout_ms(a) == 0
+        b.settimeout(0.5)
+        assert _native_io._timeout_ms(b) == 500
+        b.settimeout(None)
+        assert _native_io._timeout_ms(b) == -1
+        # empty receive buffer: a non-blocking recv must raise
+        # BlockingIOError immediately (measure: no 1ms+ poll parked us)
+        buf = memoryview(bytearray(4))
+        t0 = time.perf_counter()
+        with pytest.raises(BlockingIOError):
+            _native_io.recv_exact_into(a, buf)
+        assert time.perf_counter() - t0 < 0.25
+        # with the full frame already buffered the non-blocking read
+        # completes normally. (NOT a retry loop: recv_exact_into may
+        # consume a partial prefix before raising, so BlockingIOError —
+        # like timeout — is connection-fatal for framed callers.)
+        b.setblocking(False)
+        _native_io.send2(b, b"abcd", None, 0)
+        deadline = time.time() + 2
+        while time.time() < deadline:
+            import select
+
+            if select.select([a], [], [], 0.05)[0]:
+                break
+        _native_io.recv_exact_into(a, buf)
+        assert bytes(buf) == b"abcd"
+        # a timeout'd socket still raises socket.timeout, not
+        # BlockingIOError
+        a.settimeout(0.05)
+        with pytest.raises(socket.timeout):
+            _native_io.recv_exact_into(a, memoryview(bytearray(4)))
+    finally:
+        a.close()
+        b.close()
+
+
+def test_group_all_reduce_outs_validated():
+    """ISSUE 2 satellite: mismatched reuse buffers must fail loudly
+    before any native pointer math sees them."""
+    from kungfu_tpu import api
+
+    xs = [np.ones((4, 2), np.float32), np.ones(3, np.float32)]
+    with pytest.raises(ValueError, match="outs mismatch"):
+        api.group_all_reduce_arrays(xs, outs=[np.empty(8, np.float32)])
+    with pytest.raises(ValueError, match="size"):
+        api.group_all_reduce_arrays(
+            xs, outs=[np.empty(7, np.float32), np.empty(3, np.float32)]
+        )
+    with pytest.raises(ValueError, match="dtype"):
+        api.group_all_reduce_arrays(
+            xs, outs=[np.empty(8, np.float32), np.empty(3, np.float64)]
+        )
+    with pytest.raises(ValueError, match="contiguous"):
+        api.group_all_reduce_arrays(
+            xs,
+            outs=[np.empty((4, 4), np.float32)[:, ::2], np.empty(3, np.float32)],
+        )
+
+
 def test_policy_runner():
     from kungfu_tpu.policy import BasePolicy, PolicyRunner
 

@@ -95,6 +95,49 @@ def test_shm_ring_roundtrip(tmp_path):
     assert not os.path.exists(path)
 
 
+def test_shm_arena_prebacked_and_enospc_degrades(monkeypatch):
+    """ISSUE 2 satellite: the arena is posix_fallocate'd at creation so
+    a full tmpfs surfaces as ArenaSpaceError (graceful socket fallback)
+    instead of a SIGBUS on the first ring write."""
+    path = "/dev/shm/kfshm-test-fallocate"
+    # healthy path: creation backs the file at full size
+    tx = shm.SenderArena(path, capacity=1 << 20)
+    try:
+        assert os.stat(path).st_size == shm.HEADER + (1 << 20)
+    finally:
+        tx.close()
+    # full tmpfs: fallocate fails -> typed error, no leftover file
+    if not hasattr(os, "posix_fallocate"):
+        pytest.skip("no posix_fallocate on this platform")
+
+    def boom(fd, offset, length):
+        raise OSError(28, "No space left on device")  # ENOSPC
+
+    monkeypatch.setattr(os, "posix_fallocate", boom)
+    with pytest.raises(shm.ArenaSpaceError):
+        shm.SenderArena(path, capacity=1 << 20)
+    assert not os.path.exists(path)
+
+
+def test_shm_enospc_client_falls_back_to_socket(monkeypatch):
+    """A Client whose arena cannot be backed degrades that connection to
+    socket frames (arena table records None) and counts the fallback."""
+    from kungfu_tpu.plan.peer import PeerID
+    from kungfu_tpu.transport.client import Client
+
+    def boom(fd, offset, length):
+        raise OSError(28, "No space left on device")
+
+    monkeypatch.setattr(os, "posix_fallocate", boom)
+    cl = Client(PeerID("127.0.0.1", 39901))
+    key = (PeerID("127.0.0.1", 39902), 1)
+    try:
+        assert cl._fresh_arena(key) is None
+        assert key in cl._arenas and cl._arenas[key] is None
+    finally:
+        cl.close()  # must not crash on the None arena
+
+
 def test_shm_ring_wraps_and_backpressures():
     path = "/dev/shm/kfshm-test-wrap"
     cap = 1 << 20

@@ -66,12 +66,61 @@ def test_all_strategies_span(strategy, peers):
 def test_auto_select():
     # multi-root striping when cores can run the concurrent walks; one
     # tree on low-core hosts (context switches beat striping there)
-    expect_multi = (os.cpu_count() or 1) >= 4
+    expect_multi = st.effective_cpu_count() >= 4
     assert st.auto_select(make_peers(("a", 4))) == (
         Strategy.CLIQUE if expect_multi else Strategy.BINARY_TREE
     )
     assert st.auto_select(make_peers(("a", 2))) == Strategy.STAR
     assert st.auto_select(make_peers(("a", 2), ("b", 2))) == Strategy.MULTI_BINARY_TREE_STAR
+
+
+def _point_cgroup_at(monkeypatch, tmp_path, v2=None, v1_quota=None, v1_period=None):
+    v2_path = tmp_path / "cpu.max"
+    q_path = tmp_path / "cpu.cfs_quota_us"
+    p_path = tmp_path / "cpu.cfs_period_us"
+    if v2 is not None:
+        v2_path.write_text(v2)
+    if v1_quota is not None:
+        q_path.write_text(v1_quota)
+    if v1_period is not None:
+        p_path.write_text(v1_period)
+    monkeypatch.setattr(st, "CGROUP_V2_CPU_MAX", str(v2_path))
+    monkeypatch.setattr(st, "CGROUP_V1_QUOTA", str(q_path))
+    monkeypatch.setattr(st, "CGROUP_V1_PERIOD", str(p_path))
+
+
+def test_cgroup_quota_v2(monkeypatch, tmp_path):
+    # 150000/100000 = 1.5 cores of quota
+    _point_cgroup_at(monkeypatch, tmp_path, v2="150000 100000\n")
+    assert st._cgroup_cpu_quota() == pytest.approx(1.5)
+    # quota'd container must not pick CLIQUE on phantom cores
+    monkeypatch.setattr(os, "cpu_count", lambda: 16)
+    assert st.effective_cpu_count() == 1
+    assert st.auto_select(make_peers(("a", 4))) == Strategy.BINARY_TREE
+
+
+def test_cgroup_quota_v2_unlimited(monkeypatch, tmp_path):
+    _point_cgroup_at(monkeypatch, tmp_path, v2="max 100000\n")
+    assert st._cgroup_cpu_quota() == 0.0
+
+
+def test_cgroup_quota_v1_fallback(monkeypatch, tmp_path):
+    # no v2 file: fall back to cfs_quota/cfs_period
+    _point_cgroup_at(
+        monkeypatch, tmp_path, v1_quota="400000\n", v1_period="100000\n"
+    )
+    assert st._cgroup_cpu_quota() == pytest.approx(4.0)
+
+
+def test_cgroup_quota_v1_unlimited(monkeypatch, tmp_path):
+    _point_cgroup_at(monkeypatch, tmp_path, v1_quota="-1\n", v1_period="100000\n")
+    assert st._cgroup_cpu_quota() == 0.0
+
+
+def test_effective_cpu_count_no_cgroup(monkeypatch, tmp_path):
+    # no cgroup files at all: bounded by cpu_count/affinity, never zero
+    _point_cgroup_at(monkeypatch, tmp_path)
+    assert st.effective_cpu_count() >= 1
 
 
 def test_multi_root_strategy_counts():

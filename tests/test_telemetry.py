@@ -318,6 +318,9 @@ def test_telemetry_server_routes():
         with urllib.request.urlopen(base + "/metrics", timeout=5) as r:
             body = r.read().decode()
         assert "t_http_total 7" in body
+        # a scraper's cache-buster query must not 404 the route
+        with urllib.request.urlopen(base + "/metrics?t=1", timeout=5) as r:
+            assert "t_http_total 7" in r.read().decode()
         with urllib.request.urlopen(base + "/trace", timeout=5) as r:
             doc = json.loads(r.read().decode())
         assert any(e["name"] == "t_http_span" for e in doc["traceEvents"])
