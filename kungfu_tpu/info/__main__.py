@@ -1,5 +1,6 @@
 """Usage: python3 -m kungfu_tpu.info [--no-devices] [--telemetry [URL]]
        python3 -m kungfu_tpu.info top [--watch] [--interval S] [URL]
+       python3 -m kungfu_tpu.info postmortem [DIR|URL]
 
 Prints framework, backend and cluster-env diagnostics (parity:
 python -m kungfu.info; the CUDA/NCCL/TF report becomes JAX/TPU/KF_* —
@@ -15,7 +16,14 @@ reads the runner's /cluster/health endpoint (URL argument, or
 KF_CLUSTER_HEALTH_URL — exported to every worker by kfrun -w
 -debug-port N) and renders one row per peer: step rate, step-time
 p50/p99, bytes tx/rx, scrape age, straggler flag. --watch refreshes in
-place until interrupted."""
+place until interrupted.
+
+`postmortem` reconstructs the death timeline of crashed workers
+(ISSUE 3): point it at a telemetry run dir (KF_TELEMETRY_DIR, default
+/tmp/kungfu-telemetry/<run-id>) to read the durable postmortems.jsonl
+and per-peer flight journals, or at a live runner's debug endpoint
+(http://host:port) to fetch /cluster/postmortem. With no argument it
+uses $KF_TELEMETRY_DIR."""
 
 import json
 import os
@@ -203,9 +211,53 @@ def _cmd_top(argv) -> int:
             return 0
 
 
+def _cmd_postmortem(argv) -> int:
+    from kungfu_tpu.telemetry import flight
+
+    target = next(
+        (a for a in argv if not a.startswith("-")), ""
+    ) or os.environ.get(flight.DIR_ENV, "")
+    if not target:
+        print(
+            "info postmortem: no target — pass a telemetry run dir or a "
+            "runner debug URL (or set KF_TELEMETRY_DIR)",
+            file=sys.stderr,
+        )
+        return 2
+    if target.startswith("http"):
+        url = target.rstrip("/")
+        if not url.endswith("/cluster/postmortem"):
+            url += "/cluster/postmortem"
+        try:
+            with urllib.request.urlopen(url, timeout=5) as r:
+                doc = json.loads(r.read().decode())
+        except (OSError, ValueError) as e:
+            print(f"info postmortem: fetch {url} failed: {e}", file=sys.stderr)
+            return 1
+        pms = [pm for recs in doc.get("peers", {}).values() for pm in recs]
+    else:
+        if not os.path.isdir(target):
+            print(f"info postmortem: {target}: not a directory", file=sys.stderr)
+            return 2
+        # a single PEER dir (holds a journal itself) or a run dir
+        single = flight.harvest_peer_dir(target)
+        pms = [single] if single is not None else flight.harvest_run_dir(target)
+    if not pms:
+        print(f"no postmortems found in {target}")
+        return 0
+    pms.sort(key=lambda p: p.get("wall_time") or 0.0)
+    print(f"{len(pms)} worker death(s) on record")
+    for pm in pms:
+        print()
+        print(flight.render_postmortem(pm))
+    return 0
+
+
 def main(argv) -> None:
     if argv and argv[0] == "top":
         sys.exit(_cmd_top(argv[1:]))
+    if argv and argv[0] == "postmortem":
+        sys.exit(_cmd_postmortem(argv[1:]))
     _show_versions()
     if "--no-devices" not in argv:
         _show_devices()

@@ -112,6 +112,7 @@ class Peer:
             with trace.span("worker.start.server"):
                 self.server.start()
         self._start_telemetry_server()
+        self._start_flight_recorder()
         with trace.span("worker.start.update"):
             self._update_to(self._peers)
 
@@ -137,12 +138,29 @@ class Peer:
                 # OverflowError: peer port within 10000 of 65535
                 log.warn("telemetry server failed to start: %s", e)
 
+    def _start_flight_recorder(self) -> None:
+        """Durable flight recorder (ISSUE 3): journal telemetry
+        snapshots to disk so a SIGKILL'd/OOM'd worker leaves a black
+        box. kfrun injects KF_TELEMETRY_DIR, which turns it on; bare
+        in-process peers (tests, single_process) stay off unless asked."""
+        self.flight_recorder = None
+        if self.config.single_process:
+            return
+        from kungfu_tpu.telemetry import flight
+
+        self.flight_recorder = flight.start_recorder(peer=str(self.self_id))
+
     def stop(self) -> None:
         self.server.stop()
         self.client.close()
         if getattr(self, "metrics_server", None) is not None:
             # clean shutdown on peer exit: close the listening socket too
             self.metrics_server.stop()
+        if getattr(self, "flight_recorder", None) is not None:
+            from kungfu_tpu.telemetry import flight
+
+            flight.stop_recorder(reason="peer_stop")
+            self.flight_recorder = None
 
     # ------------------------------------------------------------------
     @property

@@ -156,6 +156,17 @@ def main(argv: Optional[List[str]] = None) -> int:
         print(f"kfrun: {e}", file=sys.stderr)
         return 2
 
+    # flight-recorder run dir (ISSUE 3): minted once per run and
+    # inherited by every worker via the environment, so all the peer
+    # journals and the runner's postmortems land under one directory.
+    # An operator-set KF_TELEMETRY_DIR wins; the default base is pruned
+    # so unattended loops don't grow /tmp forever.
+    from kungfu_tpu.telemetry import flight
+
+    if not os.environ.get(flight.DIR_ENV):
+        flight.prune_runs()
+        os.environ[flight.DIR_ENV] = flight.default_run_dir()
+
     config_server_url = args.config_server
     builtin_server = None
     if args.builtin_config_port >= 0:

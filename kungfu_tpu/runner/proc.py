@@ -7,11 +7,16 @@ files) and srcs/go/kungfu/job/job.go (env construction).
 
 from __future__ import annotations
 
+import collections
 import os
 import subprocess
 import sys
 import threading
 from typing import Dict, List, Optional
+
+# last-words ring per worker: postmortems include output even when the
+# flight journal is missing or empty (ISSUE 3 satellite)
+OUTPUT_TAIL_LINES = 200
 
 from kungfu_tpu.telemetry import log
 
@@ -72,6 +77,10 @@ class WorkerProc:
         self.cpus = cpus  # CPU affinity mask (runner/affinity.py plan)
         self.proc: Optional[subprocess.Popen] = None
         self._threads: List[threading.Thread] = []
+        self._tail: "collections.deque[str]" = collections.deque(
+            maxlen=OUTPUT_TAIL_LINES
+        )
+        self._tail_lock = threading.Lock()
 
     def start(self) -> None:
         full_env = dict(os.environ)
@@ -136,12 +145,20 @@ class WorkerProc:
             # prefix computed per line: a standby proc is renamed to its
             # worker identity on activation
             prefix = _color(self.rank, f"[{self.name}{tag}] ")
+            with self._tail_lock:
+                self._tail.append(f"[{tag or ' '}] {line.rstrip()}")
             if logfile:
                 logfile.write(f"[{tag or ' '}] {line}")
                 logfile.flush()
             if not self.quiet:
                 sys.stdout.write(prefix + line)
                 sys.stdout.flush()
+
+    def output_tail(self) -> List[str]:
+        """The worker's last ~200 stdout/stderr lines ('[ ]'/'[!]'
+        prefixed), for postmortems."""
+        with self._tail_lock:
+            return list(self._tail)
 
     def wait(self, timeout: Optional[float] = None) -> int:
         rc = self.proc.wait(timeout)
@@ -156,6 +173,12 @@ class WorkerProc:
                 self.proc.wait(5)
             except subprocess.TimeoutExpired:
                 self.proc.kill()
+                try:
+                    # reap, so returncode reads -SIGKILL instead of a
+                    # stale None in the postmortem that follows
+                    self.proc.wait(5)
+                except subprocess.TimeoutExpired:
+                    pass
 
     @property
     def running(self) -> bool:

@@ -12,13 +12,14 @@ from __future__ import annotations
 
 import collections
 import json
+import os
 import queue
 import threading
 import time
 from typing import Dict, List, Optional
 
 from kungfu_tpu.plan.cluster import Cluster
-from kungfu_tpu.telemetry import log
+from kungfu_tpu.telemetry import audit, log
 from kungfu_tpu.plan.peer import PeerID, PeerList
 from kungfu_tpu.runner.proc import WorkerProc
 from kungfu_tpu.transport.message import ConnType, Message
@@ -74,6 +75,11 @@ class DebugServer:
                 )
             if path == "/cluster/audit":
                 return json.dumps(agg.cluster_audit()), "application/json"
+            if path == "/cluster/postmortem":
+                return (
+                    json.dumps(agg.cluster_postmortem(), indent=2),
+                    "application/json",
+                )
             return None
 
         class Handler(BaseHTTPRequestHandler):
@@ -189,6 +195,13 @@ class Watcher:
         self.auto_recover = bool(getattr(args, "auto_recover", ""))
         self.failure_restarts = 0
         self.last_stage: Optional[Stage] = None
+        # flight-recorder plane (ISSUE 3): the run dir every worker
+        # journals under (kfrun cli minted it into the environment);
+        # postmortems of dead workers are harvested from it. The seen
+        # set keys on (peer, pid) so a respawned-then-dead-again peer
+        # gets a fresh postmortem but one death is never double-counted.
+        self.telemetry_dir = os.environ.get("KF_TELEMETRY_DIR", "")
+        self._postmortemed: set = set()
         # cluster observability plane (ISSUE 2): rides the -debug-port
         # endpoint; scrapes every worker's /metrics|/trace|/audit and
         # serves the merged /cluster/* views + straggler signals
@@ -382,6 +395,67 @@ class Watcher:
             if w.host == self.self_host:
                 self._spawn(w, stage)
 
+    def record_postmortems(self, dead: List[PeerID]) -> List[dict]:
+        """Crash forensics for workers that died with nonzero exit:
+        harvest each one's flight journal + faulthandler file + output
+        tail into a `worker_postmortem` audit event, the durable
+        <run-dir>/postmortems.jsonl, and the aggregator's
+        /cluster/postmortem view. Best-effort by contract — a worker
+        that left nothing behind still yields the runner-side facts."""
+        from kungfu_tpu.telemetry import flight
+
+        out: List[dict] = []
+        for w in dead:
+            with self._state_lock:
+                proc = self.current.get(w)
+            if proc is not None and proc.proc is not None:
+                # reap a just-killed child so the postmortem records
+                # -SIGKILL, not a stale None
+                try:
+                    proc.proc.wait(timeout=1.0)
+                except Exception:  # noqa: BLE001 - still running or already reaped
+                    proc.proc.poll()
+            code = proc.proc.returncode if proc is not None and proc.proc else None
+            key = (str(w), proc.proc.pid if proc is not None and proc.proc else None)
+            if key in self._postmortemed:
+                continue
+            self._postmortemed.add(key)
+            try:
+                # empty telemetry_dir (no KF_TELEMETRY_DIR plumbed, e.g.
+                # an embedded Watcher) -> runner-side facts only; the
+                # workers journal under their own self-minted run dirs
+                # this runner can't know
+                pm = flight.harvest_postmortem(
+                    self.telemetry_dir,
+                    str(w),
+                    exit_code=code,
+                    output_tail=proc.output_tail() if proc is not None else None,
+                )
+            except Exception as e:  # noqa: BLE001 - forensics must never block recovery
+                log.warn("kfrun: postmortem harvest for %s failed: %s", w, e)
+                continue
+            audit.record_event(
+                "worker_postmortem",
+                peer=str(w),
+                trigger="worker_death",
+                death=pm["death"],
+                exit_code=code,
+                last_step=pm.get("last_step"),
+                last_record_age_s=pm.get("last_record_age_s"),
+                clean_exit=pm.get("clean_exit"),
+                journal_records=pm.get("journal_records"),
+            )
+            if self.telemetry_dir:
+                flight.append_postmortem(self.telemetry_dir, pm)
+            if self.aggregator is not None:
+                self.aggregator.add_postmortem(str(w), pm)
+            log.warn(
+                "kfrun: worker_postmortem recorded for %s (%s, last step %s)",
+                w, pm["death"], pm.get("last_step"),
+            )
+            out.append(pm)
+        return out
+
     def _dead_workers(self) -> List[PeerID]:
         """Local workers that died WITHOUT a Stage removing them: exit
         code != 0 while still a cluster member = a real failure (normal
@@ -413,21 +487,33 @@ class Watcher:
         to the config server so later elastic polls don't resize the
         corpses back in."""
         self.failure_restarts += 1
+        self.record_postmortems(dead)
+        codes = {
+            str(w): (self.current[w].proc.returncode if w in self.current else "?")
+            for w in dead
+        }
         if self.failure_restarts > 10:
             log.error("kfrun: too many failure recoveries, giving up")
+            # on the record, not just a log line: the cluster audit log
+            # (and /cluster/audit) must say why the run died
+            audit.record_event(
+                "run_abort",
+                trigger="failure_recovery_limit",
+                restarts=self.failure_restarts,
+                exit_codes=codes,
+            )
             self.exit_code = 1
             self.done.set()
             return
         base = self.last_stage
         survivors = [w for w in base.cluster.workers if w not in set(dead)]
-        codes = {
-            str(w): (self.current[w].proc.returncode if w in self.current else "?")
-            for w in dead
-        }
         log.warn(
             "kfrun: workers %s died; reloading at size %d", codes, len(survivors)
         )
         if not survivors:
+            audit.record_event(
+                "run_abort", trigger="no_survivors", exit_codes=codes
+            )
             self.exit_code = 1
             self.done.set()
             return
@@ -571,6 +657,10 @@ class Watcher:
                                 idle_since = None
                                 continue
                             self.exit_code = 0 if all(c == 0 for c in codes) else 1
+                            if self.exit_code != 0:
+                                # even without auto-recover, a crashed
+                                # worker leaves its black box behind
+                                self.record_postmortems(self._dead_workers())
                             break
                     else:
                         idle_since = None

@@ -62,9 +62,10 @@ __all__ = [
     "cluster",
     "promparse",
     "straggler",
+    "flight",
 ]
 
-_LAZY_MODULES = ("cluster", "promparse", "straggler")
+_LAZY_MODULES = ("cluster", "promparse", "straggler", "flight")
 
 
 def __getattr__(name):
@@ -86,6 +87,7 @@ def dump(prefix: str = "") -> dict:
     ``audit``    resize/strategy audit records as dicts,
     ``spans``    total-ms-per-span summary (quick look).
     """
+    metrics.update_process_health()
     return {
         "features": sorted(features()),
         "metrics": metrics.render(),

@@ -31,6 +31,7 @@ or strategy switch on skew.
 
 from __future__ import annotations
 
+import collections
 import json
 import math
 import os
@@ -196,6 +197,11 @@ class TelemetryAggregator:
         self._flagged: set = set()
         self._rtt_flagged: set = set()
         self._scraped_at: Optional[float] = None  # wall time of last sweep
+        # crash forensics (ISSUE 3): postmortems harvested by the
+        # watcher, served at /cluster/postmortem. Deliberately NOT keyed
+        # off the scrape membership — dead peers leave the cluster, and
+        # their postmortems are the entire point. Bounded overall.
+        self._postmortems: "collections.deque" = collections.deque(maxlen=64)
         # a PRIVATE registry by default, not the process-global one: the
         # runner's own transport metrics carry peer labels that mean "a
         # remote peer of the runner" — mixing them into the federated
@@ -626,6 +632,26 @@ class TelemetryAggregator:
                 records.append(rec)
         records.sort(key=lambda r: r.get("wall_time", 0.0))
         return records
+
+    def add_postmortem(self, label: str, pm: dict) -> None:
+        """Record a harvested worker postmortem (watcher calls this on
+        every worker death it recovers from)."""
+        with self._lock:
+            self._postmortems.append((str(label), dict(pm)))
+
+    def cluster_postmortem(self) -> dict:
+        """The /cluster/postmortem view: every harvested death this
+        run, newest last, grouped per peer."""
+        with self._lock:
+            items = list(self._postmortems)
+        peers: Dict[str, List[dict]] = {}
+        for label, pm in items:
+            peers.setdefault(label, []).append(pm)
+        return {
+            "wall_time": time.time(),
+            "deaths": len(items),
+            "peers": peers,
+        }
 
     def cluster_health(self) -> dict:
         """The JSON health snapshot behind /cluster/health and

@@ -43,8 +43,16 @@ class TelemetryServer:
         extra_routes: Optional[Dict[str, Callable[[], "tuple[str, str]"]]] = None,
     ):
         reg = registry or metrics.get_registry()
+
+        def _metrics_page() -> "tuple[str, str]":
+            # self-health gauges (RSS/fds/threads/uptime) are sampled on
+            # demand: every scrape refreshes them, so OOM/fd-leak
+            # postmortems get a trend line without a sampler thread
+            metrics.update_process_health(reg)
+            return reg.render(), "text/plain; version=0.0.4"
+
         routes: Dict[str, Callable[[], "tuple[str, str]"]] = {
-            "/metrics": lambda: (reg.render(), "text/plain; version=0.0.4"),
+            "/metrics": _metrics_page,
             "/trace": lambda: (
                 tracing.chrome_trace_json(),
                 "application/json",

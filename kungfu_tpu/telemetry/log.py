@@ -25,13 +25,20 @@ import os
 import sys
 import threading
 import time
-from typing import Optional, TextIO
+from collections import deque
+from typing import List, Optional, TextIO
 
 LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "WARNING": 30, "ERROR": 40, "OFF": 100}
 _COLORS = [31, 32, 33, 34, 35, 36]  # red..cyan, cycled by rank
 
 _lock = threading.Lock()
 _state = {"level": None, "out": None, "prefix": None}
+
+# bounded tail of emitted lines, independent of where `out` points: the
+# flight recorder journals it so a crashed worker's last words survive
+# even when its stderr pipe died with the runner
+TAIL_LINES = 200
+_tail: "deque[str]" = deque(maxlen=TAIL_LINES)
 
 
 def _level() -> int:
@@ -90,11 +97,24 @@ def _emit(level_name: str, level: int, msg: str, args: tuple, fields: dict) -> N
     ts = time.strftime("%H:%M:%S")
     pre = _prefix()
     with _lock:
+        _tail.append(f"{ts} [{level_name[0]}] {msg}")
         try:
             out.write(f"{ts} [{level_name[0]}] kungfu{pre} {msg}\n")
             out.flush()
         except (ValueError, OSError):
             pass  # closed stream at interpreter teardown
+
+
+def tail(n: Optional[int] = None) -> List[str]:
+    """The most recent emitted log lines (level-filtered, un-colored)."""
+    with _lock:
+        lines = list(_tail)
+    return lines if n is None else lines[-n:]
+
+
+def clear_tail() -> None:
+    with _lock:
+        _tail.clear()
 
 
 def debug(msg: str, *args, **fields) -> None:

@@ -20,7 +20,9 @@ Design notes:
 from __future__ import annotations
 
 import math
+import os
 import threading
+import time
 from bisect import bisect_left
 from typing import Dict, Iterable, List, Optional, Sequence, Tuple
 
@@ -449,3 +451,70 @@ def histogram(
 
 def render() -> str:
     return REGISTRY.render()
+
+
+# -- process self-health ------------------------------------------------
+# OOM kills and fd leaks are the failure modes a postmortem most often
+# has to explain; these gauges give the flight recorder and the cluster
+# plane the trend line. Sampled on demand (every /metrics scrape and
+# every flight snapshot), not on a timer of their own.
+
+_PROC_START = time.time()
+_PAGE_SIZE = (
+    os.sysconf("SC_PAGE_SIZE") if hasattr(os, "sysconf") else 4096
+)
+
+
+def _rss_bytes() -> Optional[float]:
+    try:
+        with open("/proc/self/statm", "rb") as f:
+            return float(f.read().split()[1]) * _PAGE_SIZE
+    except (OSError, IndexError, ValueError):
+        pass
+    try:  # non-Linux fallback: peak RSS is better than nothing
+        import resource
+        import sys
+
+        maxrss = float(resource.getrusage(resource.RUSAGE_SELF).ru_maxrss)
+        # ru_maxrss is KiB on Linux/BSD but BYTES on macOS
+        return maxrss if sys.platform == "darwin" else maxrss * 1024
+    except (ImportError, ValueError, OSError):
+        return None
+
+
+def _open_fds() -> Optional[float]:
+    try:
+        return float(len(os.listdir("/proc/self/fd")))
+    except OSError:
+        return None
+
+
+def update_process_health(registry: Optional[Registry] = None) -> Dict[str, float]:
+    """Sample RSS / open fds / thread count / uptime into the registry's
+    ``kungfu_process_*`` gauges; returns what was measured."""
+    reg = registry or REGISTRY
+    out: Dict[str, float] = {}
+    rss = _rss_bytes()
+    if rss is not None:
+        reg.gauge(
+            "kungfu_process_rss_bytes", "Resident set size of this process"
+        ).set(rss)
+        out["rss_bytes"] = rss
+    fds = _open_fds()
+    if fds is not None:
+        reg.gauge(
+            "kungfu_process_open_fds", "Open file descriptors of this process"
+        ).set(fds)
+        out["open_fds"] = fds
+    n_threads = float(threading.active_count())
+    reg.gauge(
+        "kungfu_process_threads", "Live Python threads in this process"
+    ).set(n_threads)
+    out["threads"] = n_threads
+    uptime = max(time.time() - _PROC_START, 0.0)
+    reg.gauge(
+        "kungfu_process_uptime_seconds",
+        "Seconds since this process imported the metrics registry",
+    ).set(uptime)
+    out["uptime_seconds"] = uptime
+    return out

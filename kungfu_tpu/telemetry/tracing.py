@@ -46,13 +46,44 @@ class TraceEvent(NamedTuple):
 _lock = threading.Lock()
 _events: "deque[TraceEvent]" = deque(maxlen=MAX_EVENTS)
 _tls = threading.local()
+# every thread's live span stack, keyed by thread ident — the flight
+# recorder snapshots these so a postmortem can say what each thread was
+# INSIDE when the process died (a completed-span ring can't)
+_all_stacks: Dict[int, list] = {}
 
 
 def _stack() -> list:
     st = getattr(_tls, "stack", None)
     if st is None:
         st = _tls.stack = []
+        # registration is once per thread: prune dead threads' entries
+        # here too, so processes that never call open_spans() (flight
+        # recorder off) don't leak an entry per short-lived thread
+        live = {t.ident for t in threading.enumerate()}
+        me = threading.get_ident()
+        with _lock:
+            for tid in list(_all_stacks):
+                if tid not in live:
+                    del _all_stacks[tid]
+            _all_stacks[me] = st
     return st
+
+
+def open_spans() -> Dict[str, List[str]]:
+    """Currently-open (entered, not yet exited) span stacks per live
+    thread: ``{"MainThread(140003...)": ["policy.step", "allreduce"]}``.
+    Dead threads' stacks are pruned as a side effect."""
+    live = {t.ident: t.name for t in threading.enumerate()}
+    out: Dict[str, List[str]] = {}
+    with _lock:
+        for tid in list(_all_stacks):
+            if tid not in live:
+                del _all_stacks[tid]
+                continue
+            st = list(_all_stacks[tid])
+            if st:
+                out[f"{live[tid]}({tid})"] = st
+    return out
 
 
 def _append(ev: TraceEvent) -> None:

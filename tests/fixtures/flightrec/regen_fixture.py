@@ -1,0 +1,80 @@
+"""Regenerate the committed flight-recorder fixture used by
+tests/test_info_postmortem.py.
+
+Run from the repo root:
+
+    python tests/fixtures/flightrec/regen_fixture.py
+
+The fixture is one telemetry run dir with a single peer journal whose
+contents are fully deterministic (fixed wall times, no live sampling)
+and whose tail is deliberately torn mid-frame, so the smoke test also
+covers the tolerant-reader contract without spawning a cluster."""
+
+import json
+import os
+import sys
+
+HERE = os.path.dirname(os.path.abspath(__file__))
+sys.path.insert(0, os.path.join(HERE, "..", "..", ".."))
+
+from kungfu_tpu.telemetry import flight  # noqa: E402
+
+PEER = "127.0.0.1:38002"
+T0 = 1754200000.0  # fixed epoch: 2026-08-03 ~06:26 UTC
+
+
+def main() -> None:
+    d = flight.peer_dir(HERE, PEER)
+    os.makedirs(d, exist_ok=True)
+    path = os.path.join(d, flight.JOURNAL_NAME)
+    if os.path.exists(path):
+        os.remove(path)
+    w = flight.JournalWriter(path)
+    w.append({
+        "kind": "meta", "wall_time": T0, "peer": PEER, "pid": 4242,
+        "host": "fixture-host", "argv": ["python", "train.py"],
+        "python": "3.11.0", "interval_s": 5.0,
+    })
+    w.append({
+        "kind": "snapshot", "wall_time": T0 + 60.0, "perf_now": 61.5,
+        "peer": PEER, "step": 1234,
+        "metrics": (
+            "# TYPE kungfu_steps_total counter\n"
+            "kungfu_steps_total 1234\n"
+            "# TYPE kungfu_process_rss_bytes gauge\n"
+            "kungfu_process_rss_bytes 104857600\n"
+            "# TYPE kungfu_process_open_fds gauge\n"
+            "kungfu_process_open_fds 37\n"
+            "# TYPE kungfu_process_threads gauge\n"
+            "kungfu_process_threads 6\n"
+            "# TYPE kungfu_process_uptime_seconds gauge\n"
+            "kungfu_process_uptime_seconds 60\n"
+        ),
+        "spans": [["collective.all_reduce", 61.2, 12.5]],
+        "open_spans": {"MainThread(1)": ["policy.step", "collective.all_reduce"]},
+        "audit": [{
+            "kind": "resize", "wall_time": T0 + 30.0, "peer": PEER,
+            "trigger": "config_server", "old_size": 4, "new_size": 3,
+        }],
+        "log_tail": [
+            "06:27:00 [I] step 1233 loss=0.42",
+            "06:27:00 [W] peer 127.0.0.1:38003 rtt spike 84ms",
+        ],
+    })
+    # torn tail: a frame header promising more bytes than exist
+    w.close()
+    with open(path, "ab") as f:
+        f.write(b"\xff\x00\x00\x00\x99\x99")
+    with open(os.path.join(d, flight.FAULT_NAME), "w") as f:
+        f.write(
+            "Fatal Python error: Segmentation fault\n\n"
+            'Current thread 0x00000001 (most recent call first):\n'
+            '  File "train.py", line 99 in step\n'
+        )
+    with open(os.path.join(d, flight.META_NAME), "w") as f:
+        json.dump({"peer": PEER, "pid": 4242, "wall_time": T0}, f, indent=2)
+    print(f"fixture regenerated under {d}")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,557 @@
+"""Durable flight recorder + crash forensics (ISSUE 3): journal format
+recovery, recorder lifecycle, postmortem harvesting/rendering, the
+aggregator's /cluster/postmortem view, and the satellite hooks
+(process-health gauges, log tail, open spans, proc output ring)."""
+
+import json
+import os
+import signal
+import struct
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from kungfu_tpu.telemetry import flight, log, metrics, tracing
+
+
+@pytest.fixture(autouse=True)
+def _clean_telemetry():
+    yield
+    log.clear_tail()
+    tracing.clear()
+
+
+# ---------------------------------------------------------------------------
+# journal format
+# ---------------------------------------------------------------------------
+
+class TestJournalFormat:
+    def test_round_trip(self, tmp_path):
+        path = str(tmp_path / "journal.bin")
+        w = flight.JournalWriter(path)
+        recs = [{"kind": "snapshot", "i": i, "payload": "x" * i} for i in range(20)]
+        for r in recs:
+            w.append(r)
+        w.close()
+        got, err = flight.read_journal_file(path)
+        assert err is None
+        assert got == recs
+
+    def test_truncated_tail_is_skipped_not_fatal(self, tmp_path):
+        path = str(tmp_path / "journal.bin")
+        w = flight.JournalWriter(path)
+        for i in range(5):
+            w.append({"i": i})
+        w.close()
+        # tear the final record at every possible byte boundary: all 5
+        # complete records must always come back, never an exception
+        blob = open(path, "rb").read()
+        w2 = flight.JournalWriter(str(tmp_path / "j2.bin"))
+        w2.append({"i": 99})
+        w2.close()
+        tail = open(str(tmp_path / "j2.bin"), "rb").read()[len(flight.MAGIC):]
+        for cut in range(1, len(tail)):
+            torn = str(tmp_path / "torn.bin")
+            with open(torn, "wb") as f:
+                f.write(blob + tail[:cut])
+            got, err = flight.read_journal_file(torn)
+            assert [r["i"] for r in got] == [0, 1, 2, 3, 4]
+            assert err is not None  # and it says WHY it stopped
+
+    def test_corrupt_crc_tail_is_skipped(self, tmp_path):
+        path = str(tmp_path / "journal.bin")
+        w = flight.JournalWriter(path)
+        for i in range(3):
+            w.append({"i": i})
+        w.close()
+        payload = b'{"i": "evil"}'
+        frame = struct.pack("<II", len(payload), 0xDEADBEEF) + payload
+        with open(path, "ab") as f:
+            f.write(frame)
+        got, err = flight.read_journal_file(path)
+        assert [r["i"] for r in got] == [0, 1, 2]
+        assert "CRC" in err
+
+    def test_bad_magic(self, tmp_path):
+        path = str(tmp_path / "junk.bin")
+        with open(path, "wb") as f:
+            f.write(b"not a journal at all")
+        got, err = flight.read_journal_file(path)
+        assert got == [] and "magic" in err
+
+    def test_missing_file(self, tmp_path):
+        got, errs = flight.read_journal(str(tmp_path))
+        assert got == [] and errs == []
+
+    def test_rotation_bounds_disk_and_keeps_history(self, tmp_path):
+        path = str(tmp_path / flight.JOURNAL_NAME)
+        w = flight.JournalWriter(path, max_bytes=4096)
+        for i in range(200):
+            w.append({"i": i, "pad": "x" * 100})
+        w.close()
+        assert os.path.getsize(path) <= 4096
+        prev = str(tmp_path / flight.JOURNAL_PREV_NAME)
+        assert os.path.exists(prev)
+        got, errs = flight.read_journal(str(tmp_path))
+        assert errs == []
+        idx = [r["i"] for r in got]
+        # contiguous recent history across the rotation boundary,
+        # ending at the last record written
+        assert idx[-1] == 199
+        assert idx == list(range(idx[0], 200))
+
+
+# ---------------------------------------------------------------------------
+# recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def _recorder(self, tmp_path, peer="127.0.0.1:38000", **kw):
+        d = flight.peer_dir(str(tmp_path), peer)
+        kw.setdefault("interval", 1000.0)
+        kw.setdefault("install_signal_handlers", False)
+        return flight.FlightRecorder(d, peer=peer, **kw)
+
+    def test_snapshot_contents(self, tmp_path):
+        rec = self._recorder(tmp_path)
+        log.info("something happened", step=3)
+        with tracing.span("test.outer"):
+            rec.snapshot()
+        rec.close(reason="test")
+        records, errs = flight.read_journal(rec.dir)
+        assert errs == []
+        kinds = [r["kind"] for r in records]
+        assert kinds[0] == "meta" and kinds[-1] == "exit"
+        snap = next(r for r in records if r["kind"] == "snapshot")
+        assert "kungfu_process_rss_bytes" in snap["metrics"]
+        assert any("something happened" in l for l in snap["log_tail"])
+        # the span was OPEN when the snapshot was taken
+        assert any(
+            "test.outer" in stack
+            for stack in snap["open_spans"].values()
+        )
+
+    def test_close_is_idempotent_first_reason_wins(self, tmp_path):
+        rec = self._recorder(tmp_path)
+        rec.close(reason="sigterm")
+        rec.close(reason="atexit")
+        records, _ = flight.read_journal(rec.dir)
+        exits = [r for r in records if r["kind"] == "exit"]
+        assert len(exits) == 1 and exits[0]["reason"] == "sigterm"
+
+    def test_faulthandler_file_created(self, tmp_path):
+        rec = self._recorder(tmp_path)
+        assert os.path.exists(os.path.join(rec.dir, flight.FAULT_NAME))
+        rec.close()
+
+    def test_meta_json_written(self, tmp_path):
+        rec = self._recorder(tmp_path, peer="10.0.0.1:9000")
+        meta = json.load(open(os.path.join(rec.dir, flight.META_NAME)))
+        assert meta["peer"] == "10.0.0.1:9000"
+        assert meta["pid"] == os.getpid()
+        rec.close()
+
+    def test_periodic_snapshots(self, tmp_path):
+        rec = self._recorder(tmp_path, interval=0.05)
+        rec.start()
+        deadline = time.time() + 5.0
+        while time.time() < deadline:
+            records, _ = flight.read_journal(rec.dir)
+            if sum(r["kind"] == "snapshot" for r in records) >= 2:
+                break
+            time.sleep(0.02)
+        rec.close()
+        records, _ = flight.read_journal(rec.dir)
+        assert sum(r["kind"] == "snapshot" for r in records) >= 2
+
+    def test_start_recorder_respects_disable(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(flight.DIR_ENV, str(tmp_path))
+        monkeypatch.setenv(flight.FLIGHT_ENV, "0")
+        assert flight.start_recorder(peer="x") is None
+
+    def test_start_recorder_idempotent(self, tmp_path, monkeypatch):
+        monkeypatch.setenv(flight.DIR_ENV, str(tmp_path))
+        monkeypatch.delenv(flight.FLIGHT_ENV, raising=False)
+        try:
+            r1 = flight.start_recorder(peer="127.0.0.1:1")
+            r2 = flight.start_recorder(peer="127.0.0.1:2")
+            assert r1 is not None and r1 is r2
+        finally:
+            flight.stop_recorder()
+
+    def test_sigusr2_dump(self, tmp_path):
+        if not hasattr(signal, "SIGUSR2"):
+            pytest.skip("no SIGUSR2 on this platform")
+        prev_usr2 = signal.getsignal(signal.SIGUSR2)
+        prev_term = signal.getsignal(signal.SIGTERM)
+        d = flight.peer_dir(str(tmp_path), "usr2")
+        rec = flight.FlightRecorder(
+            d, peer="usr2", interval=1000.0,
+            enable_faulthandler=False, install_signal_handlers=True,
+        )
+        try:
+            os.kill(os.getpid(), signal.SIGUSR2)
+            deadline = time.time() + 5.0
+            dumps = []
+            while time.time() < deadline and not dumps:
+                records, _ = flight.read_journal(d)
+                dumps = [r for r in records if r["kind"] == "dump"]
+                time.sleep(0.02)
+            assert dumps and dumps[0]["reason"] == "sigusr2"
+        finally:
+            rec.close()
+            signal.signal(signal.SIGUSR2, prev_usr2)
+            signal.signal(signal.SIGTERM, prev_term)
+
+
+# ---------------------------------------------------------------------------
+# harvesting + rendering
+# ---------------------------------------------------------------------------
+
+class TestHarvest:
+    def test_harvest_with_journal(self, tmp_path):
+        peer = "127.0.0.1:38000"
+        rec = flight.FlightRecorder(
+            flight.peer_dir(str(tmp_path), peer), peer=peer,
+            interval=1000.0, install_signal_handlers=False,
+        )
+        log.warn("gradient blew up")
+        rec.snapshot()
+        rec.journal.close()  # simulate SIGKILL: no exit record
+        pm = flight.harvest_postmortem(
+            str(tmp_path), peer, exit_code=-9,
+            output_tail=["[!] Killed"],
+        )
+        assert pm["death"] == "signal SIGKILL (-9)"
+        assert pm["clean_exit"] is False
+        assert pm["journal_records"] >= 2
+        assert any("gradient blew up" in l for l in pm["log_tail"])
+        assert pm["output_tail"] == ["[!] Killed"]
+        assert pm["process_health"].get("rss_bytes", 0) > 0
+        text = flight.render_postmortem(pm)
+        assert "SIGKILL" in text
+        assert "no exit record" in text
+        assert "gradient blew up" in text
+        rec.close()
+
+    def test_harvest_without_journal(self, tmp_path):
+        pm = flight.harvest_postmortem(
+            str(tmp_path), "127.0.0.1:40000", exit_code=7,
+            output_tail=["[ ] last words"],
+        )
+        assert pm["death"] == "exit code 7"
+        assert pm["journal_records"] == 0
+        text = flight.render_postmortem(pm)
+        assert "last words" in text
+        assert "empty or missing" in text
+
+    def test_second_incarnation_sigkill_not_masked_by_first_clean_exit(
+        self, tmp_path
+    ):
+        peer = "127.0.0.1:38000"
+        d = flight.peer_dir(str(tmp_path), peer)
+        first = flight.FlightRecorder(
+            d, peer=peer, interval=1000.0, install_signal_handlers=False
+        )
+        first.close(reason="peer_stop")  # incarnation 1: clean
+        second = flight.FlightRecorder(
+            d, peer=peer, interval=1000.0, install_signal_handlers=False
+        )
+        second.snapshot()
+        second.journal.close()  # incarnation 2: killed
+        pm = flight.harvest_postmortem(str(tmp_path), peer, exit_code=-9)
+        assert pm["clean_exit"] is False
+
+    def test_postmortems_jsonl_round_trip(self, tmp_path):
+        pm = {"kind": "worker_postmortem", "peer": "a:1", "wall_time": 5.0}
+        path = flight.append_postmortem(str(tmp_path), pm)
+        assert path and os.path.exists(path)
+        # torn final line: same tolerant contract as the journal
+        with open(path, "a") as f:
+            f.write('{"kind": "worker_postm')
+        got = flight.read_postmortems(str(tmp_path))
+        assert got == [pm]
+
+    def test_harvest_run_dir_prefers_durable_postmortems(self, tmp_path):
+        flight.append_postmortem(
+            str(tmp_path), {"kind": "worker_postmortem", "peer": "a:1"}
+        )
+        pms = flight.harvest_run_dir(str(tmp_path))
+        assert len(pms) == 1 and pms[0]["peer"] == "a:1"
+
+    def test_harvest_run_dir_merges_unrecorded_deaths(self, tmp_path):
+        """A partial postmortems.jsonl (runner died mid-recovery) must
+        not hide journaled unclean deaths — but normally-completed
+        workers are not added as deaths."""
+        flight.append_postmortem(
+            str(tmp_path),
+            {"kind": "worker_postmortem", "peer": "127.0.0.1:38000"},
+        )
+        # peer B: journaled, no exit record (unclean) -> must appear
+        b = flight.FlightRecorder(
+            flight.peer_dir(str(tmp_path), "127.0.0.1:38001"),
+            peer="127.0.0.1:38001", interval=1000.0,
+            install_signal_handlers=False,
+        )
+        b.snapshot()
+        b.journal.close()
+        # peer C: clean exit -> must NOT appear as a death
+        c = flight.FlightRecorder(
+            flight.peer_dir(str(tmp_path), "127.0.0.1:38002"),
+            peer="127.0.0.1:38002", interval=1000.0,
+            install_signal_handlers=False,
+        )
+        c.close(reason="peer_stop")
+        pms = flight.harvest_run_dir(str(tmp_path))
+        peers = sorted(pm["peer"] for pm in pms)
+        assert peers == ["127.0.0.1:38000", "127.0.0.1:38001"]
+        b.close()
+
+    def test_harvest_run_dir_falls_back_to_journals(self, tmp_path):
+        peer = "127.0.0.1:38000"
+        rec = flight.FlightRecorder(
+            flight.peer_dir(str(tmp_path), peer), peer=peer,
+            interval=1000.0, install_signal_handlers=False,
+        )
+        rec.snapshot()
+        rec.journal.close()
+        pms = flight.harvest_run_dir(str(tmp_path))
+        assert len(pms) == 1 and pms[0]["peer"] == peer
+
+    def test_harvest_empty_run_dir_skips_disk(self):
+        """No KF_TELEMETRY_DIR plumbed: runner-side facts only, and no
+        probing of relative/structurally-wrong paths."""
+        pm = flight.harvest_postmortem(
+            "", "a:1", exit_code=-9, output_tail=["[!] x"]
+        )
+        assert pm["journal_dir"] is None
+        assert pm["journal_records"] == 0
+        assert pm["faulthandler"] is None
+        assert pm["death"] == "signal SIGKILL (-9)"
+
+    def test_harvest_peer_dir_direct(self, tmp_path):
+        peer = "127.0.0.1:38000"
+        rec = flight.FlightRecorder(
+            flight.peer_dir(str(tmp_path), peer), peer=peer,
+            interval=1000.0, install_signal_handlers=False,
+        )
+        rec.close(reason="x")
+        pm = flight.harvest_peer_dir(str(tmp_path / "127.0.0.1_38000"))
+        assert pm is not None and pm["peer"] == peer
+        assert flight.harvest_peer_dir(str(tmp_path)) is None  # run dir
+
+    def test_harvest_renamed_peer_dir(self, tmp_path):
+        """A peer dir copied out of its run for offline forensics must
+        still harvest its own journal (not a label re-derivation)."""
+        import shutil
+
+        peer = "127.0.0.1:38000"
+        rec = flight.FlightRecorder(
+            flight.peer_dir(str(tmp_path), peer), peer=peer,
+            interval=1000.0, install_signal_handlers=False,
+        )
+        rec.snapshot()
+        rec.close(reason="x")
+        copied = str(tmp_path / "evidence")
+        shutil.copytree(flight.peer_dir(str(tmp_path), peer), copied)
+        pm = flight.harvest_peer_dir(copied)
+        assert pm is not None and pm["peer"] == peer
+        assert pm["journal_records"] >= 3
+
+    def test_describe_exit(self):
+        assert flight.describe_exit(0) == "exit code 0"
+        assert flight.describe_exit(None) == "unknown"
+        assert "SIGKILL" in flight.describe_exit(-9)
+        assert "SIGTERM" in flight.describe_exit(-15)
+        # a signal number outside signal.Signals must not double-prefix
+        assert flight.describe_exit(-250) == "signal 250 (-250)"
+
+
+# ---------------------------------------------------------------------------
+# aggregator + endpoint
+# ---------------------------------------------------------------------------
+
+class TestClusterPostmortem:
+    def test_add_and_view(self):
+        from kungfu_tpu.telemetry.cluster import TelemetryAggregator
+
+        agg = TelemetryAggregator(interval=1000.0)
+        agg.add_postmortem("a:1", {"kind": "worker_postmortem", "peer": "a:1"})
+        agg.add_postmortem("a:1", {"kind": "worker_postmortem", "peer": "a:1"})
+        agg.add_postmortem("b:2", {"kind": "worker_postmortem", "peer": "b:2"})
+        doc = agg.cluster_postmortem()
+        assert doc["deaths"] == 3
+        assert len(doc["peers"]["a:1"]) == 2
+        # membership churn must NOT drop dead peers' postmortems
+        agg.set_peers([])
+        assert agg.cluster_postmortem()["deaths"] == 3
+
+    def test_endpoint(self):
+        from kungfu_tpu.runner.watch import DebugServer
+        from kungfu_tpu.telemetry.cluster import TelemetryAggregator
+
+        class StubWatcher:
+            def __init__(self, agg):
+                self.aggregator = agg
+
+            def debug_dump(self):
+                return {}
+
+        agg = TelemetryAggregator(interval=1000.0)
+        agg.add_postmortem(
+            "127.0.0.1:38002",
+            {"kind": "worker_postmortem", "peer": "127.0.0.1:38002",
+             "death": "signal SIGKILL (-9)", "wall_time": 1.0},
+        )
+        srv = DebugServer(StubWatcher(agg), 0)
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/cluster/postmortem"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                doc = json.loads(r.read().decode())
+            assert doc["deaths"] == 1
+            assert doc["peers"]["127.0.0.1:38002"][0]["death"].startswith("signal")
+        finally:
+            srv.stop()
+
+
+# ---------------------------------------------------------------------------
+# satellite hooks
+# ---------------------------------------------------------------------------
+
+class TestSatelliteHooks:
+    def test_process_health_gauges(self):
+        vals = metrics.update_process_health()
+        assert vals["threads"] >= 1
+        assert vals["uptime_seconds"] >= 0
+        page = metrics.render()
+        for name in (
+            "kungfu_process_rss_bytes",
+            "kungfu_process_open_fds",
+            "kungfu_process_threads",
+            "kungfu_process_uptime_seconds",
+        ):
+            assert name in page, name
+
+    def test_metrics_endpoint_refreshes_health(self):
+        from kungfu_tpu.telemetry.http import TelemetryServer
+
+        srv = TelemetryServer(0, host="127.0.0.1")
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/metrics"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                body = r.read().decode()
+            assert "kungfu_process_rss_bytes" in body
+        finally:
+            srv.stop()
+
+    def test_log_tail(self):
+        log.clear_tail()
+        for i in range(5):
+            log.info("tail line %d", i)
+        t = log.tail()
+        assert len(t) == 5 and "tail line 4" in t[-1]
+        assert log.tail(2) == t[-2:]
+
+    def test_log_tail_bounded(self):
+        log.clear_tail()
+        for i in range(log.TAIL_LINES + 50):
+            log.info("x%d", i)
+        assert len(log.tail()) == log.TAIL_LINES
+
+    def test_open_spans_cross_thread(self):
+        entered = threading.Event()
+        release = threading.Event()
+
+        def worker():
+            with tracing.span("bg.outer"):
+                with tracing.span("bg.inner"):
+                    entered.set()
+                    release.wait(5)
+
+        t = threading.Thread(target=worker, name="span-holder")
+        t.start()
+        try:
+            assert entered.wait(5)
+            spans = tracing.open_spans()
+            stacks = [s for k, s in spans.items() if "span-holder" in k]
+            assert stacks == [["bg.outer", "bg.inner"]]
+        finally:
+            release.set()
+            t.join(5)
+        # after the thread exits its stack is pruned
+        spans = tracing.open_spans()
+        assert not any("span-holder" in k for k in spans)
+
+    def test_sigterm_ignorers_keep_ignoring(self, tmp_path):
+        """Installing the recorder over SIG_IGN must not turn an
+        ignored SIGTERM into process death — flush, then keep living."""
+        import subprocess
+        import sys
+
+        d = str(tmp_path)
+        code = (
+            "import os, signal, sys, time\n"
+            f"sys.path.insert(0, {os.path.dirname(os.path.dirname(os.path.abspath(__file__)))!r})\n"
+            f"os.environ['KF_TELEMETRY_DIR'] = {d!r}\n"
+            "signal.signal(signal.SIGTERM, signal.SIG_IGN)\n"
+            "from kungfu_tpu.telemetry import flight\n"
+            "flight.start_recorder(peer='ign:1')\n"
+            "print('ready', flush=True)\n"
+            "time.sleep(30)\n"
+            "print('survived', flush=True)\n"
+        )
+        p = subprocess.Popen(
+            [sys.executable, "-c", code], stdout=subprocess.PIPE, text=True
+        )
+        try:
+            assert p.stdout.readline().strip() == "ready"
+            p.terminate()
+            time.sleep(1.0)
+            assert p.poll() is None, "SIG_IGN process died on SIGTERM"
+            # and the flush still happened
+            recs, _ = flight.read_journal(flight.peer_dir(d, "ign:1"))
+            assert any(
+                r["kind"] == "exit" and r["reason"] == "sigterm" for r in recs
+            )
+        finally:
+            p.kill()
+            p.wait(10)
+
+    def test_span_stack_registry_prunes_without_open_spans(self):
+        """Short-lived threads using span() must not leak registry
+        entries even when open_spans() is never called."""
+        def worker():
+            with tracing.span("leak.check"):
+                pass
+
+        before = len(tracing._all_stacks)
+        for _ in range(8):
+            t = threading.Thread(target=worker)
+            t.start()
+            t.join(5)
+        # trigger one registration from a fresh thread: it prunes
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join(5)
+        assert len(tracing._all_stacks) <= before + 2
+
+    def test_worker_proc_output_tail(self):
+        import sys
+
+        from kungfu_tpu.runner.proc import WorkerProc
+
+        code = (
+            "import sys\n"
+            "print('out line')\n"
+            "print('err line', file=sys.stderr)\n"
+        )
+        p = WorkerProc("t", [sys.executable, "-c", code], {}, quiet=True)
+        p.start()
+        assert p.wait(30) == 0
+        tail = p.output_tail()
+        assert "[ ] out line" in tail
+        assert "[!] err line" in tail

@@ -1,0 +1,68 @@
+"""`python -m kungfu_tpu.info postmortem` against the committed fixture
+journal (ISSUE 3 satellite): the CLI death-timeline path stays covered
+by tier-1 without spawning a cluster. The fixture's journal tail is
+deliberately torn, so this also pins the tolerant-reader contract.
+Regenerate via tests/fixtures/flightrec/regen_fixture.py."""
+
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+FIXTURE = os.path.join(REPO, "tests", "fixtures", "flightrec")
+
+
+def _run(*argv, env_extra=None):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env.pop("KF_TELEMETRY_DIR", None)
+    if env_extra:
+        env.update(env_extra)
+    return subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.info", "postmortem", *argv],
+        capture_output=True, text=True, timeout=120, env=env, cwd=REPO,
+    )
+
+
+def test_postmortem_renders_fixture_timeline():
+    r = _run(FIXTURE)
+    assert r.returncode == 0, r.stderr
+    out = r.stdout
+    assert "1 worker death(s) on record" in out
+    assert "== postmortem: 127.0.0.1:38002 ==" in out
+    # journal facts survive the torn tail
+    assert "last step: 1234" in out
+    assert "rss=100.0MiB fds=37 threads=6" in out
+    assert "policy.step > collective.all_reduce" in out
+    assert "resize" in out and '"old_size": 4' in out
+    assert "step 1233 loss=0.42" in out
+    assert "Segmentation fault" in out
+    assert "truncated frame header" in out
+    assert "complete records up to the tear were recovered" in out
+    # no exit record in the fixture -> flagged as an unflushed death
+    assert "no exit record" in out
+
+
+def test_postmortem_accepts_single_peer_dir():
+    r = _run(os.path.join(FIXTURE, "127.0.0.1_38002"))
+    assert r.returncode == 0, r.stderr
+    assert "== postmortem: 127.0.0.1:38002 ==" in r.stdout
+    assert "last step: 1234" in r.stdout
+
+
+def test_postmortem_env_fallback():
+    r = _run(env_extra={"KF_TELEMETRY_DIR": FIXTURE})
+    assert r.returncode == 0, r.stderr
+    assert "127.0.0.1:38002" in r.stdout
+
+
+def test_postmortem_no_target_is_a_clear_error():
+    r = _run()
+    assert r.returncode == 2
+    assert "KF_TELEMETRY_DIR" in r.stderr
+
+
+def test_postmortem_empty_dir(tmp_path):
+    r = _run(str(tmp_path))
+    assert r.returncode == 0, r.stderr
+    assert "no postmortems found" in r.stdout

@@ -1,0 +1,113 @@
+"""Escaped-label round trips through exposition parsing + federation
+(ISSUE 3 satellite): backslash, newline and double-quote inside label
+values must survive registry render -> parse -> peer-label injection ->
+re-render -> re-parse bit-exactly, or the cluster plane silently
+corrupts federated series identities."""
+
+import math
+
+import pytest
+
+from kungfu_tpu.telemetry import promparse
+from kungfu_tpu.telemetry.metrics import Registry
+
+NASTY_VALUES = [
+    'back\\slash',
+    'new\nline',
+    'quo"te',
+    'all\\three\n"at once',
+    'trailing backslash\\',
+    '\\',
+    '\n',
+    '"',
+    '',
+    'comma,equals=brace}close',
+    '{open brace',
+    'unknown escape kept: \\t literal',
+]
+
+
+class TestEscapedLabelRoundTrip:
+    @pytest.mark.parametrize("value", NASTY_VALUES)
+    def test_registry_render_parse(self, value):
+        reg = Registry()
+        reg.gauge("kf_test_gauge", "g", ("lv",)).labels(value).set(3.0)
+        samples = promparse.parse_text(reg.render())
+        got = [s for s in samples if s.name == "kf_test_gauge"]
+        assert len(got) == 1
+        assert got[0].labels_dict() == {"lv": value}
+        assert got[0].value == 3.0
+
+    @pytest.mark.parametrize("value", NASTY_VALUES)
+    def test_federation_round_trip(self, value):
+        reg = Registry()
+        reg.counter("kf_test_total", "c", ("lv",)).labels(value).inc(2)
+        page = reg.render()
+        merged = promparse.merge_expositions([("10.0.0.1:38000", page)])
+        samples = [
+            s for s in promparse.parse_text(merged) if s.name == "kf_test_total"
+        ]
+        assert len(samples) == 1
+        assert samples[0].labels_dict() == {
+            "peer": "10.0.0.1:38000",
+            "lv": value,
+        }
+        assert samples[0].value == 2.0
+
+    @pytest.mark.parametrize("value", NASTY_VALUES)
+    def test_double_federation_is_stable(self, value):
+        """Re-federating an already-federated page (runner-of-runners)
+        must not decay escapes: peer collides into exported_peer and the
+        nasty value is still intact."""
+        reg = Registry()
+        reg.gauge("kf_test_gauge", "g", ("lv",)).labels(value).set(1.5)
+        once = promparse.merge_expositions([("peer-a", reg.render())])
+        twice = promparse.merge_expositions([("outer", once)])
+        samples = [
+            s for s in promparse.parse_text(twice) if s.name == "kf_test_gauge"
+        ]
+        assert len(samples) == 1
+        d = samples[0].labels_dict()
+        assert d["peer"] == "outer"
+        assert d["exported_peer"] == "peer-a"
+        assert d["lv"] == value
+
+    def test_nasty_peer_label_itself(self):
+        reg = Registry()
+        reg.gauge("kf_test_gauge", "g").set(1.0)
+        merged = promparse.merge_expositions([('host"with\nnasty\\label', reg.render())])
+        samples = [
+            s for s in promparse.parse_text(merged) if s.name == "kf_test_gauge"
+        ]
+        assert samples[0].labels_dict() == {"peer": 'host"with\nnasty\\label'}
+
+    def test_histogram_label_values_round_trip(self):
+        reg = Registry()
+        h = reg.histogram(
+            "kf_test_seconds", "h", ("op",), buckets=(0.1, 1.0)
+        )
+        h.labels('all\\three\n"at once').observe(0.5)
+        merged = promparse.merge_expositions([("p", reg.render())])
+        samples = promparse.parse_text(merged)
+        buckets = [s for s in samples if s.name == "kf_test_seconds_bucket"]
+        assert len(buckets) == 3  # 0.1, 1.0, +Inf
+        for s in buckets:
+            assert s.labels_dict()["op"] == 'all\\three\n"at once'
+        assert promparse.sample_value(
+            samples, "kf_test_seconds_count", op='all\\three\n"at once'
+        ) == 1.0
+        inf_bucket = [
+            s for s in buckets if s.labels_dict()["le"] == "+Inf"
+        ]
+        assert inf_bucket and inf_bucket[0].value == 1.0
+
+    def test_special_values_survive(self):
+        text = 'kf_v{a="x"} +Inf\nkf_v{a="y"} -Inf\nkf_v{a="z"} NaN\n'
+        merged = promparse.merge_expositions([("p", text)])
+        samples = {
+            s.labels_dict()["a"]: s.value
+            for s in promparse.parse_text(merged)
+        }
+        assert samples["x"] == math.inf
+        assert samples["y"] == -math.inf
+        assert math.isnan(samples["z"])
