@@ -64,6 +64,26 @@ def reduce_inplace(acc: np.ndarray, incoming: np.ndarray, op: ReduceOp) -> None:
     transform2(acc, acc, incoming, op)
 
 
+def reduce_segment(
+    acc: np.ndarray, begin: int, end: int, incoming: np.ndarray, op: ReduceOp
+) -> None:
+    """acc[begin:end] = acc[begin:end] `op` incoming, in place.
+
+    Offset segment reduction for the segmented ring walk: the accumulator
+    is a zero-copy view into the full recv buffer, so per-step reduction
+    touches only the 1/k segment on the wire — no staging copies, no
+    full-payload passes."""
+    seg = acc[begin:end]
+    transform2(seg, seg, incoming, op)
+
+
+def copy_segment(
+    dst: np.ndarray, begin: int, end: int, incoming: np.ndarray
+) -> None:
+    """dst[begin:end] = incoming (all-gather phase: overwrite, no reduce)."""
+    np.copyto(dst[begin:end], incoming)
+
+
 def transform_n(dst: np.ndarray, srcs, op: ReduceOp) -> None:
     """dst = srcs[0] op srcs[1] op ... op srcs[k-1] in ONE memory pass
     (native kernel); dst must not alias any src. The k-1 pairwise

@@ -24,6 +24,14 @@ class Strategy(enum.IntEnum):
     AUTO = 6
     MULTI_BINARY_TREE_STAR = 7
     MULTI_STAR = 8
+    # Bandwidth-optimal segmented ring: allreduce runs as a (k-1)-step
+    # reduce-scatter over contiguous segments followed by a (k-1)-step
+    # all-gather, so each peer moves only 2*(k-1)/k of the payload instead
+    # of relaying full copies through tree/star roots. Executed by the
+    # engine's dedicated segmented walk, not a graph pair; the residual
+    # graph ops (reduce/broadcast/gather) fall back to a rank-0 binary
+    # tree (see collective/strategies.py).
+    RING_SEGMENTED = 9
 
     @classmethod
     def parse(cls, name: str) -> "Strategy":

@@ -53,6 +53,20 @@ def bench_xla(model: str, iters: int, warmup: int = 3) -> None:
     log.echo(f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) [XLA x{n} devices, {model}]")
 
 
+def _wire_samples() -> dict:
+    """Per-(collective, strategy) wire-byte counter values for THIS
+    worker process (each worker owns its registry, so these are true
+    per-peer numbers — the in-process test suite only sees aggregates)."""
+    from kungfu_tpu.telemetry import metrics as tmetrics
+
+    ctr = tmetrics.counter(
+        "kungfu_collective_wire_bytes_total",
+        "Host-plane collective payload bytes sent by this peer",
+        ("collective", "strategy"),
+    )
+    return {labels: value for _, labels, value in ctr.samples()}
+
+
 def bench_host(model: str, iters: int, warmup: int = 2) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
@@ -66,12 +80,14 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
     # bench warms up identically)
     for i in range(warmup):
         api.group_all_reduce_arrays(grads, name=f"warmup:{i}", outs=outs)
+    wire_before = _wire_samples()
     samples = []
     for i in range(iters):
         t0 = time.perf_counter()
         api.group_all_reduce_arrays(grads, name=f"bench:{i}", outs=outs)
         dt = time.perf_counter() - t0
         samples.append(total_bytes / dt / (1 << 30))
+    wire_after = _wire_samples()
     mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
     if api.current_rank() == 0:
         med = float(np.median(samples))
@@ -79,6 +95,17 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
             f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) median {med:.3f} "
             f"[HOST x{api.cluster_size()} workers, {model}]"
         )
+        # per-peer wire bytes (this rank): the A/B number behind the
+        # segmented engine — 2(k-1)/k x payload vs full-payload relays
+        for labels, after in sorted(wire_after.items()):
+            delta = after - wire_before.get(labels, 0.0)
+            if delta <= 0:
+                continue
+            per_iter = delta / iters
+            log.echo(
+                f"WIRE {labels}: {per_iter / (1 << 20):.1f} MiB/iter "
+                f"({per_iter / total_bytes:.2f}x payload)"
+            )
         # where the time went (hot-path spans, this process only)
         summary = api.trace_summary()
         top = sorted(summary.items(), key=lambda kv: -kv[1])[:6]
@@ -183,7 +210,23 @@ def main() -> None:
     p.add_argument("--method", choices=["XLA", "HOST", "P2P", "GNS"], default="XLA")
     p.add_argument("--model", default="resnet50-imagenet")
     p.add_argument("--iters", type=int, default=10)
+    p.add_argument(
+        "--algo", choices=["auto", "tree", "segmented"], default="",
+        help="HOST engine A/B: force the collective algorithm family "
+        "(sets KF_CONFIG_ALGO before the session comes up; every worker "
+        "runs the same argv so the override is cluster-agreed)",
+    )
     args = p.parse_args()
+    if args.method == "HOST":
+        import os
+
+        if args.algo:
+            os.environ["KF_CONFIG_ALGO"] = args.algo
+        # wire-byte accounting rides the metrics gate; the bench wants it
+        # on regardless so the A/B always reports bytes per peer
+        from kungfu_tpu.telemetry import config as tconfig
+
+        tconfig.enable("metrics")
     if args.method == "XLA":
         bench_xla(args.model, args.iters)
     elif args.method == "P2P":

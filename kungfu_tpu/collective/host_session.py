@@ -16,13 +16,20 @@ rchannel data plane.
 from __future__ import annotations
 
 import os
+import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence
+from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
-from kungfu_tpu.base.ops import ReduceOp, reduce_inplace, transform_n
+from kungfu_tpu.base.ops import (
+    ReduceOp,
+    copy_segment,
+    reduce_inplace,
+    reduce_segment,
+    transform_n,
+)
 from kungfu_tpu.telemetry import config as tconfig
 from kungfu_tpu.telemetry import metrics as tmetrics
 from kungfu_tpu.utils import trace
@@ -31,6 +38,7 @@ from kungfu_tpu.collective.adaptive import AdaptiveState
 from kungfu_tpu.base.workspace import Workspace, even_partition
 from kungfu_tpu.collective import strategies as st
 from kungfu_tpu.collective.strategies import effective_cpu_count
+from kungfu_tpu.plan import topology as topo
 from kungfu_tpu.plan.graph import Graph
 from kungfu_tpu.plan.peer import PeerID, PeerList
 from kungfu_tpu.transport.client import Client
@@ -49,6 +57,29 @@ CHUNK_BYTES = int(os.environ.get("KF_CONFIG_CHUNK_BYTES", "0"))
 _CHUNK_MIN = 1 << 20
 _CHUNK_MAX = 32 << 20
 DEFAULT_TIMEOUT = 120.0
+
+# A/B algorithm override (benchmarks, operators): forces the engine onto
+# one family regardless of the configured/AUTO strategy. Like every other
+# engine knob it MUST agree cluster-wide (peers that resolved different
+# algorithms would wait on each other's rendezvous names forever).
+_ALGO_STRATEGY = {
+    "": None,
+    "auto": Strategy.AUTO,
+    "tree": Strategy.BINARY_TREE,
+    "segmented": Strategy.RING_SEGMENTED,
+}
+
+
+def algo_override() -> Optional[Strategy]:
+    """Parse KF_CONFIG_ALGO (read per session epoch, not import time)."""
+    raw = os.environ.get("KF_CONFIG_ALGO", "").strip().lower()
+    try:
+        return _ALGO_STRATEGY[raw]
+    except KeyError:
+        raise ValueError(
+            f"KF_CONFIG_ALGO must be one of "
+            f"{sorted(k for k in _ALGO_STRATEGY if k)}, got {raw!r}"
+        ) from None
 
 
 def choose_chunk_bytes(total: int) -> int:
@@ -129,7 +160,7 @@ class _CollectiveScope:
     class-based — so the per-call telemetry cost stays at two clock
     reads, a deque append and an optional histogram observe)."""
 
-    __slots__ = ("_sess", "_kind", "_span", "_t0")
+    __slots__ = ("_sess", "_kind", "_span", "_t0", "_prev_kind")
 
     def __init__(self, sess: "HostSession", kind: str, nbytes: int):
         self._sess = sess
@@ -140,11 +171,18 @@ class _CollectiveScope:
 
     def __enter__(self):
         self._t0 = time.perf_counter()
+        # label wire-byte counts with the public collective that caused
+        # them (walks run on pool threads, so this lives on the session;
+        # rare concurrent collectives of different kinds may cross-label
+        # a few bytes, which accounting tolerates)
+        self._prev_kind = self._sess._wire_kind
+        self._sess._wire_kind = self._kind
         self._span.__enter__()
         return self
 
     def __exit__(self, *exc):
         self._span.__exit__(*exc)
+        self._sess._wire_kind = self._prev_kind
         hist = self._sess._coll_hist
         if hist is not None:
             hist.labels(self._kind).observe(time.perf_counter() - self._t0)
@@ -176,21 +214,32 @@ class HostSession:
         self.client = client
         self.endpoint = endpoint
         self.timeout = timeout
+        forced = algo_override()
+        if forced is not None:
+            strategy = forced
         if strategy == Strategy.AUTO:
             strategy = st.auto_select(peers)
         self.strategy = strategy
         self.global_strategies = st.gen_global_strategies(peers, strategy)
         self.local_strategies = st.gen_local_strategies(peers)
         self.cross_strategies = st.gen_cross_strategies(peers, strategy)
+        # ring order for the cross-host segmented walk (hierarchical mode)
+        self._masters, _ = peers.partition_by_host()
+        # per-root star graph cache (satellite: reduce/broadcast with
+        # root != 0 regenerated star + default-reduce on every call);
+        # sessions are rebuilt each epoch, so invalidation is automatic
+        self._root_graphs: Dict[int, Tuple[Graph, Graph]] = {}
         # adaptive control (parity: session/adaptiveStrategies.go): a
         # deterministic candidate order — identical on every peer — so a
         # majority vote can advance everyone in lockstep. Candidate graph
         # lists are built lazily: sessions are rebuilt every elastic epoch
-        # and most never adapt.
+        # and most never adapt. RING_SEGMENTED sits first among the
+        # alternates so interference votes can switch ONTO the
+        # bandwidth-optimal member (and off it, by advancing again).
         self._candidate_names = [strategy] + [
             s for s in (
-                Strategy.RING, Strategy.BINARY_TREE_STAR, Strategy.STAR,
-                Strategy.CLIQUE,
+                Strategy.RING_SEGMENTED, Strategy.RING,
+                Strategy.BINARY_TREE_STAR, Strategy.STAR, Strategy.CLIQUE,
             ) if s != strategy
         ]
         self._candidates_built: dict = {0: self.global_strategies}
@@ -208,6 +257,20 @@ class HostSession:
             if tconfig.metrics_enabled()
             else None
         )
+        # wire-byte accounting: bytes this peer SENDS into collective
+        # walks, by (public collective, executing strategy). This is the
+        # counter the segmented engine's bandwidth-optimality claim is
+        # asserted against (tests) and the A/B bench reports.
+        self._wire_ctr = (
+            tmetrics.counter(
+                "kungfu_collective_wire_bytes_total",
+                "Host-plane collective payload bytes sent by this peer",
+                ("collective", "strategy"),
+            )
+            if tconfig.metrics_enabled()
+            else None
+        )
+        self._wire_kind = "raw"
 
     def _candidate(self, idx: int) -> List[st.StrategyPair]:
         if idx not in self._candidates_built:
@@ -229,14 +292,84 @@ class HostSession:
         metrics are on. Returns a context manager."""
         return _CollectiveScope(self, kind, nbytes)
 
+    def _count_wire(self, nbytes: int, strategy_label: str) -> None:
+        if self._wire_ctr is not None and nbytes:
+            self._wire_ctr.labels(self._wire_kind, strategy_label).inc(nbytes)
+
+    def _walk_label(self) -> str:
+        """Strategy label for graph-walk wire accounting. Labels the
+        graphs that actually EXECUTED: when RING_SEGMENTED is active but
+        a payload fell below SEGMENT_MIN_BYTES, the walk ran the binary-
+        tree fallback graphs and must not pollute the RING_SEGMENTED
+        series (it is the one the optimality assertion reads)."""
+        if self._tree_override:
+            return "SET_TREE"
+        active = self._candidate_names[self.adaptive.active]
+        if active == Strategy.RING_SEGMENTED:
+            return Strategy.BINARY_TREE.name
+        return active.name
+
+    def _recv_collective(
+        self, peer: PeerID, name: str, nbytes: int, dtype, count: int,
+        timeout: float,
+    ):
+        """Receive (peer, name) into a pooled scratch buffer — delivered
+        straight off the socket when we're parked first (sink path), else
+        from the buffered Message (possibly a zero-copy shm borrow).
+        Returns (ndarray view, scratch-or-None to return to the pool,
+        release-or-None to call once the view has been consumed). Shared
+        by the graph walk and the segmented walk so the borrow/release/
+        leak-on-timeout contract lives in ONE place. On error the scratch
+        is deliberately NOT returned to the pool: a timed-out sink may
+        still be mid-fill by the transport thread."""
+        bufpool = get_buffer_pool()
+        scratch = bufpool.get(nbytes)
+        msg, filled = self.endpoint.recv_into(
+            peer, name, memoryview(scratch), timeout
+        )
+        if filled:
+            return np.frombuffer(scratch, dtype, count), scratch, None
+        bufpool.put(scratch)  # unused: sender raced us or size mismatch
+        return np.frombuffer(msg.data, dtype, count), None, msg.release
+
     # ------------------------------------------------------------------
     # public collectives
     # ------------------------------------------------------------------
 
+    # Segmentation pays only when the per-step segment amortizes the
+    # 2*(k-1) serialized message latencies; below this the rank-0 binary
+    # tree fallback graphs win. MUST be cluster-agreed (it decides which
+    # rendezvous names a peer waits on) — like CHUNK_BYTES, the default
+    # is a constant and the env override must be set fleet-wide.
+    SEGMENT_MIN_BYTES = int(
+        os.environ.get("KF_CONFIG_SEGMENT_MIN_BYTES", "") or (64 << 10)
+    )
+
+    def _segmented_active(self) -> bool:
+        return (
+            not self._tree_override
+            and self.size >= 2
+            and self._candidate_names[self.adaptive.active]
+            == Strategy.RING_SEGMENTED
+        )
+
+    def _allreduce_ws(
+        self, w: Workspace, cancel: Optional[threading.Event] = None
+    ) -> None:
+        """Engine dispatch for one allreduce workspace: the segmented
+        ring walk when RING_SEGMENTED is active and the payload is worth
+        segmenting, else chunked graph walks. `cancel` (group/window
+        scope) propagates so an abandoned walk observes the caller's
+        timeout before mutating recv buffers."""
+        if self._segmented_active() and w.recv.nbytes >= self.SEGMENT_MIN_BYTES:
+            self._run_segmented(w, cancel=cancel)
+        else:
+            self._run_strategies(w, self.global_strategies, cancel)
+
     def all_reduce(self, w: Workspace) -> None:
         with self._collected("all_reduce", w.recv.nbytes):
             with stall_detect(f"all_reduce({w.name})"):
-                self._run_strategies(w, self.global_strategies)
+                self._allreduce_ws(w)
 
     # concurrent workspaces per batch in group ops: concurrency only pays
     # when cores exist to run the walks (on a 1-core host it just adds
@@ -259,10 +392,26 @@ class HostSession:
     # standard DDP/Horovod answer and is strictly better here.
     FUSE_MIN_TENSORS = int(os.environ.get("KF_CONFIG_GROUP_FUSE_MIN", "4"))
 
+    # Fused-bucket size cap: fused groups split into buckets that pack /
+    # walk / unpack as a 3-stage pipeline, so the cap trades per-walk
+    # fixed cost (bigger buckets) against pack/unpack overlap (smaller
+    # buckets start their walk sooner and unpack while the next bucket is
+    # on the wire). Measured on the 2-core bench box: 8 MiB buckets pay
+    # 12 walks' fixed cost for resnet50 and run 2x SLOWER than one big
+    # bucket; 64 MiB is within noise of a single bucket while still
+    # pipelining multi-hundred-MB sets (bert ~700 MB -> 11 buckets).
+    # Part of the fused workspace name, so it MUST be cluster-agreed
+    # like CHUNK_BYTES (which also rules out core-count scaling here).
+    GROUP_BUCKET_BYTES = int(
+        os.environ.get("KF_CONFIG_GROUP_BUCKET_BYTES", "") or (64 << 20)
+    )
+
     def group_all_reduce(self, ws: Sequence[Workspace]) -> None:
         """Allreduce of many workspaces as one windowed group op (parity:
         the reference reduces a whole gradient set per session.run —
-        srcs/python/kungfu/tensorflow/v1/benchmarks)."""
+        srcs/python/kungfu/tensorflow/v1/benchmarks). Fused buckets run
+        through the 3-stage pipeline while the singles windows walk
+        concurrently — neither waits for the other to finish."""
         if not ws:
             return
         with self._collected(
@@ -274,31 +423,77 @@ class HostSession:
                 if w.is_empty:
                     continue
                 groups.setdefault((w.send.dtype.str, int(w.op)), []).append(w)
-            fused_jobs: List[Callable[[], None]] = []
+            buckets: List[List[Workspace]] = []
             for members in groups.values():
                 if len(members) < self.FUSE_MIN_TENSORS:
                     singles.extend(members)
                 else:
-                    fused_jobs.append(
-                        lambda ms=members: self._fused_all_reduce(ms)
-                    )
-            for job in fused_jobs:
-                job()
-            for i in range(0, len(singles), self.GROUP_WINDOW):
-                batch = singles[i : i + self.GROUP_WINDOW]
-                _par(
-                    [
-                        lambda w=w: self._run_strategies(w, self.global_strategies)
-                        for w in batch
-                    ],
-                    self.timeout,
+                    buckets.extend(self._make_buckets(members))
+            jobs: List[Callable[[], None]] = []
+            # the group deadline scales with the number of walks it
+            # covers — the serial predecessor allowed one self.timeout
+            # PER fused walk / singles window, and a large healthy group
+            # on a slow link must not trip a single flat budget
+            windows = -(-len(singles) // self.GROUP_WINDOW)
+            group_timeout = self.timeout * max(1, len(buckets) + windows)
+            # shared cancel: a group-level timeout must also abort the
+            # pipeline stages, or a lingering unpacker would keep writing
+            # caller recv buffers after this call already raised (the
+            # late-write hazard _par's contract exists to prevent)
+            cancel = threading.Event()
+            if buckets:
+                jobs.append(
+                    lambda: self._fused_pipeline(buckets, group_timeout, cancel)
                 )
+            if singles:
+                jobs.append(lambda: self._singles_windows(singles, cancel))
+            _par(jobs, group_timeout, cancel)
 
-    def _fused_all_reduce(self, members: List[Workspace]) -> None:
-        """Pack same-(dtype, op) workspaces into one contiguous buffer,
-        allreduce once, unpack. Workspace order is the caller's tensor
-        order, which is identical on every peer, so the fused name and
-        layout agree cluster-wide."""
+    def _make_buckets(
+        self, members: List[Workspace]
+    ) -> List[List[Workspace]]:
+        """Greedy, order-preserving packing of same-(dtype, op)
+        workspaces into <= GROUP_BUCKET_BYTES buckets. Derived only from
+        the caller's tensor order and the byte cap, so every peer computes
+        the same layout (the fused name encodes it); an oversized single
+        tensor gets a bucket of its own."""
+        buckets: List[List[Workspace]] = []
+        cur: List[Workspace] = []
+        cur_bytes = 0
+        for w in members:
+            if cur and cur_bytes + w.send.nbytes > self.GROUP_BUCKET_BYTES:
+                buckets.append(cur)
+                cur, cur_bytes = [], 0
+            cur.append(w)
+            cur_bytes += w.send.nbytes
+        if cur:
+            buckets.append(cur)
+        return buckets
+
+    def _singles_windows(
+        self,
+        singles: List[Workspace],
+        cancel: Optional[threading.Event] = None,
+    ) -> None:
+        for i in range(0, len(singles), self.GROUP_WINDOW):
+            if cancel is not None and cancel.is_set():
+                # the group already raised (timeout, or a pipeline-stage
+                # error that set the shared cancel): stop launching
+                # windows, but return QUIETLY — raising here would race
+                # the real error to _par's errs[0] and misreport a
+                # deterministic failure as 'cancelled'
+                return
+            batch = singles[i : i + self.GROUP_WINDOW]
+            _par(
+                [lambda w=w: self._allreduce_ws(w, cancel) for w in batch],
+                self.timeout,
+                cancel,
+            )
+
+    def _pack_bucket(self, bi: int, members: List[Workspace]):
+        """Pack one bucket into pooled contiguous buffers. Workspace
+        order is the caller's tensor order, identical on every peer, so
+        the fused name and layout agree cluster-wide."""
         dtype = members[0].send.dtype
         op = members[0].op
         total = sum(w.send.size for w in members)
@@ -306,30 +501,124 @@ class HostSession:
         pool = get_buffer_pool()
         send_b = pool.get(nbytes)
         recv_b = pool.get(nbytes)
+        with trace.span("host.fuse.pack"):
+            send = np.frombuffer(send_b, dtype, total)
+            recv = np.frombuffer(recv_b, dtype, total)
+            off = 0
+            for w in members:
+                send[off : off + w.send.size] = w.send
+                off += w.send.size
+        fused = Workspace(
+            send=send,
+            recv=recv,
+            op=op,
+            name=f"{members[0].name}::fused:b{bi}:{len(members)}x{total}",
+        )
+        return (fused, send_b, recv_b, members)
+
+    def _unpack_bucket(self, item) -> None:
+        fused, send_b, recv_b, members = item
+        pool = get_buffer_pool()
         try:
-            with trace.span("host.fuse.pack"):
-                send = np.frombuffer(send_b, dtype, total)
-                recv = np.frombuffer(recv_b, dtype, total)
-                off = 0
-                for w in members:
-                    send[off : off + w.send.size] = w.send
-                    off += w.send.size
-            fused = Workspace(
-                send=send,
-                recv=recv,
-                op=op,
-                name=f"{members[0].name}::fused{len(members)}x{total}",
-            )
-            with trace.span("host.fuse.walk"):
-                self._run_strategies(fused, self.global_strategies)
             with trace.span("host.fuse.unpack"):
                 off = 0
                 for w in members:
-                    np.copyto(w.recv, recv[off : off + w.recv.size])
+                    np.copyto(w.recv, fused.recv[off : off + w.recv.size])
                     off += w.recv.size
         finally:
             pool.put(send_b)
             pool.put(recv_b)
+
+    def _fused_pipeline(
+        self,
+        buckets: List[List[Workspace]],
+        timeout: float,
+        cancel: Optional[threading.Event] = None,
+    ) -> None:
+        """3-stage software pipeline over fused buckets: pack bucket i+1
+        and unpack bucket i-1 while bucket i is on the wire. The serial
+        predecessor (all packs, then all walks, then all unpacks per
+        bucket) left the wire idle during every memcpy phase. Depth-1
+        handoff queues bound live pooled buffers at 5 buckets (one per
+        stage + one per queue) — x2 buffers x GROUP_BUCKET_BYTES, well
+        under the serial path's single whole-group buffer pair for big
+        sets. Every queue get/put is abort-aware, so any stage's failure
+        (or a dropped sentinel after one) unblocks the other two and the
+        REAL error propagates out of _par; aborted in-flight buffers are
+        dropped to GC (the pool's documented policy for buffers a worker
+        may still touch)."""
+        packed: "queue.Queue" = queue.Queue(maxsize=1)
+        unpackq: "queue.Queue" = queue.Queue(maxsize=1)
+        # the caller's cancel event doubles as the abort flag: _par sets
+        # it on timeout, so every stage (unpacker included) stops before
+        # touching caller buffers again
+        abort = cancel if cancel is not None else threading.Event()
+
+        def put(q: "queue.Queue", item) -> bool:
+            """Bounded put that gives up once the pipeline aborts."""
+            while True:
+                try:
+                    q.put(item, timeout=0.2)
+                    return True
+                except queue.Full:
+                    if abort.is_set():
+                        return False
+
+        def get(q: "queue.Queue"):
+            """Blocking get that turns into the sentinel on abort, so a
+            consumer can never be stranded by a lost sentinel."""
+            while True:
+                try:
+                    return q.get(timeout=0.2)
+                except queue.Empty:
+                    if abort.is_set():
+                        return None
+
+        def packer():
+            try:
+                for bi, members in enumerate(buckets):
+                    if abort.is_set():
+                        return
+                    if not put(packed, self._pack_bucket(bi, members)):
+                        return
+            except BaseException:
+                abort.set()
+                raise
+            finally:
+                put(packed, None)
+
+        def walker():
+            try:
+                while True:
+                    item = get(packed)
+                    if item is None:
+                        return
+                    if abort.is_set():
+                        continue  # drain to the sentinel
+                    with trace.span("host.fuse.walk"):
+                        self._allreduce_ws(item[0])
+                    if not put(unpackq, item):
+                        return
+            except BaseException:
+                abort.set()
+                raise
+            finally:
+                put(unpackq, None)
+
+        def unpacker():
+            try:
+                while True:
+                    item = get(unpackq)
+                    if item is None:
+                        return
+                    if abort.is_set():
+                        continue  # aborted: must not touch caller buffers
+                    self._unpack_bucket(item)
+            except BaseException:
+                abort.set()
+                raise
+
+        _par([packer, walker, unpacker], timeout, abort)
 
     def monitored_all_reduce(self, w: Workspace) -> None:
         """AllReduce + throughput accounting for the ACTIVE strategy
@@ -339,7 +628,7 @@ class HostSession:
         t0 = time.perf_counter()
         with self._collected("monitored_all_reduce", nbytes):
             with stall_detect(f"monitored_all_reduce({w.name})"):
-                self._run_strategies(w, self.global_strategies)
+                self._allreduce_ws(w)
         self.adaptive.current.update(nbytes, time.perf_counter() - t0)
 
     def check_interference(self, vote_tag: str = "") -> bool:
@@ -412,15 +701,41 @@ class HostSession:
         return self.adaptive.summary()
 
     def cross_all_reduce(self, w: Workspace) -> None:
-        """AllReduce across host masters only (hierarchical path)."""
+        """AllReduce across host masters only (hierarchical path). While
+        RING_SEGMENTED is the ACTIVE strategy, masters run the segmented
+        walk over the master ring (the subset/cross variant); non-masters
+        forward. Gated on _segmented_active — not the static configured
+        strategy — so set_tree overrides and adaptive switches govern the
+        cross path exactly like the global one (votes advance in lockstep
+        on every peer, so the gate stays cluster-consistent)."""
         with stall_detect(f"cross_all_reduce({w.name})"):
-            self._run_strategies(w, self.cross_strategies)
+            if (
+                self._segmented_active()
+                and len(self._masters) >= 2
+                and w.recv.nbytes >= self.SEGMENT_MIN_BYTES
+            ):
+                self._run_segmented(w, ranks=self._masters)
+            else:
+                self._run_strategies(w, self.cross_strategies)
 
     def local_reduce(self, w: Workspace) -> None:
         self._run_graphs(w, [self.local_strategies[0].reduce_graph])
 
     def local_broadcast(self, w: Workspace) -> None:
         self._run_graphs(w, [self.local_strategies[0].bcast_graph])
+
+    def _root_star_graphs(self, root: int) -> Tuple[Graph, Graph]:
+        """(bcast, reduce) star graphs rooted at `root`, cached on the
+        session — reduce/broadcast/broadcast_bytes used to regenerate
+        them on every call (a Graph build is O(size) allocations, paid
+        per elastic state-sync message). Benign to race: both writers
+        compute identical graphs."""
+        pair = self._root_graphs.get(root)
+        if pair is None:
+            bcast = topo.gen_star_bcast_graph(self.size, root)
+            pair = (bcast, topo.gen_default_reduce_graph(bcast))
+            self._root_graphs[root] = pair
+        return pair
 
     def reduce(self, w: Workspace, root: int = 0) -> None:
         """Reduce to `root` (parity: runGraphs with a reduce graph; the
@@ -430,12 +745,7 @@ class HostSession:
             self._run_graphs(w, [self.global_strategies[0].reduce_graph])
         else:
             self._check_root(root)
-            from kungfu_tpu.plan import topology as _topo
-
-            g = _topo.gen_default_reduce_graph(
-                _topo.gen_star_bcast_graph(self.size, root)
-            )
-            self._run_graphs(w, [g])
+            self._run_graphs(w, [self._root_star_graphs(root)[1]])
 
     def broadcast(self, w: Workspace, root: int = 0) -> None:
         with self._collected("broadcast", w.recv.nbytes):
@@ -443,11 +753,7 @@ class HostSession:
                 self._run_graphs(w, [self.global_strategies[0].bcast_graph])
             else:
                 self._check_root(root)
-                from kungfu_tpu.plan import topology as _topo
-
-                self._run_graphs(
-                    w, [_topo.gen_star_bcast_graph(self.size, root)]
-                )
+                self._run_graphs(w, [self._root_star_graphs(root)[0]])
 
     def _check_root(self, root: int) -> None:
         if not 0 <= root < self.size:
@@ -477,25 +783,33 @@ class HostSession:
         self.all_reduce(w)
 
     def bytes_consensus(self, bs: bytes, name: str) -> bool:
-        """True iff every peer supplied identical bytes (session.go:126-157):
-        min/max allreduce of the length, then of the padded bytes."""
+        """True iff every peer supplied identical bytes (parity:
+        session.go:126-157, which runs 4 allreduce rounds). 2 rounds
+        here: a MIN-allreduce of the packed (len, -len) int64 workspace
+        yields the cluster's (min-len, -max-len) in one walk, and a
+        MIN-allreduce of the two-lane (payload, 255-payload) bytes yields
+        (elementwise-min, 255-elementwise-max) in another — consensus iff
+        min == max in both. Every elastic resize and strategy switch pays
+        this path, so halving the rounds halves its serialized latency."""
         n = len(bs)
-        lo = np.array([n], np.int32)
-        hi = np.array([n], np.int32)
-        out_lo = np.zeros(1, np.int32)
-        out_hi = np.zeros(1, np.int32)
-        self.all_reduce(Workspace(lo, out_lo, ReduceOp.MIN, f":consensus:len:min:{name}"))
-        self.all_reduce(Workspace(hi, out_hi, ReduceOp.MAX, f":consensus:len:max:{name}"))
-        if out_lo[0] != out_hi[0]:
+        lens = np.array([n, -n], np.int64)
+        out_len = np.zeros(2, np.int64)
+        self.all_reduce(
+            Workspace(lens, out_len, ReduceOp.MIN, f":consensus:len:{name}")
+        )
+        if out_len[0] != -out_len[1]:
             return False
         if n == 0:
             return True
         x = np.frombuffer(bs, np.uint8)
-        out1 = np.zeros(n, np.uint8)
-        out2 = np.zeros(n, np.uint8)
-        self.all_reduce(Workspace(x, out1, ReduceOp.MIN, f":consensus:min:{name}"))
-        self.all_reduce(Workspace(x, out2, ReduceOp.MAX, f":consensus:max:{name}"))
-        return bool(np.array_equal(out1, out2))
+        lanes = np.empty(2 * n, np.uint8)
+        lanes[:n] = x
+        np.subtract(255, x, out=lanes[n:])
+        out = np.zeros(2 * n, np.uint8)
+        self.all_reduce(
+            Workspace(lanes, out, ReduceOp.MIN, f":consensus:data:{name}")
+        )
+        return bool(np.array_equal(out[:n], 255 - out[n:]))
 
     def broadcast_bytes(self, bs: bytes, name: str, root: int = 0) -> bytes:
         """Broadcast variable-length bytes from `root` (two graph walks:
@@ -504,11 +818,9 @@ class HostSession:
         collective (gpu_collective.cpp:190-212) — and for elastic state
         re-sync, where the root must be a SURVIVING peer (not necessarily
         rank 0 of the new cluster)."""
-        from kungfu_tpu.plan import topology as _topo
-
         # a fixed star keeps the walk root-correct regardless of the active
         # strategy (set_tree/adaptive switches may re-root global_strategies)
-        graph = _topo.gen_star_bcast_graph(self.size, root)
+        graph = self._root_star_graphs(root)[0]
         n_send = np.array([len(bs) if self.rank == root else 0], np.int64)
         n_recv = np.zeros(1, np.int64)
         self._run_graphs(
@@ -540,6 +852,7 @@ class HostSession:
                 self.client.send(
                     self.peers[root], w.name, _buf(w.send), ConnType.COLLECTIVE
                 )
+                self._count_wire(w.send.nbytes, "STAR")
             return
         scope = self._collected("gather", w.recv.nbytes)
         scope.__enter__()
@@ -597,11 +910,153 @@ class HostSession:
     # engine
     # ------------------------------------------------------------------
 
-    def _run_strategies(self, w: Workspace, strategies: List[st.StrategyPair]) -> None:
+    def _run_segmented(
+        self,
+        w: Workspace,
+        ranks: Optional[Sequence[int]] = None,
+        cancel: Optional[threading.Event] = None,
+    ) -> None:
+        """Bandwidth-optimal segmented walk: a (k-1)-step reduce-scatter
+        over contiguous segments followed by a (k-1)-step all-gather
+        around a ring (arXiv:1810.11112 §3; the TPU-pod MLPerf stack
+        leans on the same segmented summation, arXiv:1909.09756). Each
+        step sends ONE ~N/k segment to the ring successor and reduces
+        (or, in the gather phase, copies) the segment arriving from the
+        predecessor in place — zero-copy views into the recv buffer, no
+        full-payload relays, ~2*(k-1)/k*N bytes moved per peer total.
+
+        Contracts shared with the graph walk: receives prefer the
+        zero-copy sink/shm-borrow path (`recv_into`) and release borrows
+        after the in-place reduce; one deadline bounds the WHOLE walk (not
+        per step); a timed-out scratch buffer is never returned to the
+        pool (the transport thread may still be mid-fill); empty segments
+        (payload < k elements) are skipped identically on both ends of
+        every edge, so no peer waits on a message that never departs.
+
+        `ranks` restricts the ring to a subset (hierarchical cross-host
+        mode); non-members just forward send into recv."""
+        if w.is_empty:
+            w.forward()
+            return
+        members = list(range(self.size)) if ranks is None else list(ranks)
+        k = len(members)
+        if self.rank not in members or k == 1:
+            w.forward()
+            return
+        sched = topo.gen_segmented_schedule(members, members.index(self.rank))
+        bounds = even_partition(w.recv.size, k)
+        w.forward()  # seed the accumulator with own contribution
+        acc = w.recv
+        send_peer = self.peers[sched.send_peer]
+        recv_peer = self.peers[sched.recv_peer]
+        itemsize = acc.itemsize
+        bufpool = get_buffer_pool()
+        deadline = time.monotonic() + self.timeout
+        wire = 0
+
+        def do_send(name: str, sb: int, se: int) -> None:
+            """Deadline-bounded send: a frozen successor (full shm ring
+            -> socket fallback -> full TCP buffer) would otherwise block
+            sendall forever and the walk-wide deadline — checked only in
+            do_recv — would never fire. Dispatch + event-wait costs tens
+            of µs per step, noise against the segment memcpy. A timed-out
+            send thread is abandoned exactly like the graph walk's _par
+            send threads; the zero-copy view stays valid because the
+            caller raises out of the walk without touching acc again."""
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"segmented walk timed out: {name}")
+            done = threading.Event()
+            errs: List[BaseException] = []
+
+            def run() -> None:
+                try:
+                    # zero-copy: segments are disjoint and steps
+                    # sequential per workspace, so this view cannot be
+                    # mutated mid-sendall
+                    self.client.send(
+                        send_peer, name, _buf(acc[sb:se]), ConnType.COLLECTIVE
+                    )
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    errs.append(e)
+                finally:
+                    done.set()
+
+            get_pool().submit(run)
+            if not done.wait(remaining):
+                raise TimeoutError(f"segmented send timed out: {name}")
+            if errs:
+                raise errs[0]
+
+        def do_recv(name: str, rb: int, re_: int, reducing: bool) -> None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"segmented walk timed out: {name}")
+            incoming, scratch, release = self._recv_collective(
+                recv_peer, name, (re_ - rb) * itemsize, acc.dtype,
+                re_ - rb, remaining,
+            )
+            try:
+                if cancel is not None and cancel.is_set():
+                    # caller-scope timeout fired while we were blocked:
+                    # the recv buffer may already be reused — a late
+                    # arrival must not be reduced into it
+                    raise TimeoutError(f"collective cancelled: {name}")
+                if reducing:
+                    reduce_segment(acc, rb, re_, incoming, w.op)
+                else:
+                    copy_segment(acc, rb, re_, incoming)
+            finally:
+                del incoming
+                if release is not None:
+                    release()
+            if scratch is not None:
+                bufpool.put(scratch)
+
+        def step(phase: str, s: int, send_seg: int, recv_seg: int, reducing: bool) -> None:
+            nonlocal wire
+            sb, se = bounds[send_seg]
+            rb, re_ = bounds[recv_seg]
+            name = f"{w.name}:{phase}{s}"
+            if cancel is not None and cancel.is_set():
+                raise TimeoutError(f"collective cancelled: {name}")
+            # empty segments (payload < k elements) are skipped on BOTH
+            # ends: sender and receiver compute identical bounds.
+            # send-then-recv is deliberately SEQUENTIAL: the send returns
+            # once the payload is in the shm ring / kernel buffer, so the
+            # wire is already busy while we block on the predecessor —
+            # and a _par pair per step measured 15% slower on the 2-core
+            # bench box (thread dispatch + GIL beat the overlap).
+            if se > sb:
+                do_send(name, sb, se)
+                wire += (se - sb) * itemsize
+            if re_ > rb:
+                do_recv(name, rb, re_, reducing)
+
+        _t0 = time.perf_counter()
+        for s, (snd, rcv) in enumerate(sched.rs_steps):
+            with trace.span("host.rs.step", step=s, k=k):
+                step("rs", s, snd, rcv, True)
+        for s, (snd, rcv) in enumerate(sched.ag_steps):
+            with trace.span("host.ag.step", step=s, k=k):
+                step("ag", s, snd, rcv, False)
+        self._count_wire(wire, Strategy.RING_SEGMENTED.name)
+        trace.record(
+            f"host.segmented[{w.recv.nbytes >> 20}MiB]",
+            time.perf_counter() - _t0,
+        )
+
+    def _run_strategies(
+        self,
+        w: Workspace,
+        strategies: List[st.StrategyPair],
+        cancel: Optional[threading.Event] = None,
+    ) -> None:
         total = w.recv.size * w.recv.itemsize
         k = max(1, -(-total // choose_chunk_bytes(total)))
         chunks = w.split(even_partition, k) if k > 1 else [w]
-        cancel = threading.Event()
+        if cancel is None:
+            cancel = threading.Event()
         if k == 1:
             pair = strategies[0]
             self._run_graphs(chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel)
@@ -644,36 +1099,23 @@ class HostSession:
                 return w.recv
             return w.send
 
+        wire_label = self._walk_label()
+
         def send_to(peer: PeerID, flags: Flags = Flags.NONE) -> None:
             # zero-copy: the walk's phases are sequential per chunk, so the
             # buffer cannot be mutated while sendall drains it
             self.client.send(
                 peer, w.name, _buf(effective()), ConnType.COLLECTIVE, flags
             )
+            self._count_wire(nbytes, wire_label)
 
         bufpool = get_buffer_pool()
         nbytes = w.recv.size * w.recv.itemsize
 
         def recv_payload(peer: PeerID):
-            """Receive (peer, w.name) into a pooled scratch buffer —
-            delivered straight off the socket when we're parked first
-            (sink path), else from the buffered Message (possibly a
-            zero-copy shm borrow). Returns (ndarray view, scratch-or-None
-            to return to the pool, release-or-None to call once the view
-            has been consumed)."""
-            scratch = bufpool.get(nbytes)
-            # on error the scratch is deliberately NOT returned to the pool:
-            # a timed-out sink may still be mid-fill by the transport thread
-            msg, filled = self.endpoint.recv_into(
-                peer, w.name, memoryview(scratch), self.timeout
-            )
-            if filled:
-                return np.frombuffer(scratch, w.send.dtype), scratch, None
-            bufpool.put(scratch)  # unused: sender raced us or size mismatch
-            return (
-                np.frombuffer(msg.data, w.send.dtype),
-                None,
-                msg.release,
+            """See _recv_collective (shared with the segmented walk)."""
+            return self._recv_collective(
+                peer, w.name, nbytes, w.send.dtype, w.recv.size, self.timeout
             )
 
         def recv_onto(peer: PeerID) -> None:

@@ -100,22 +100,23 @@ def effective_cpu_count() -> int:
 
 
 def auto_select(peers: PeerList) -> Strategy:
-    """Single host: CLIQUE (one star per root) so chunked collectives
-    stripe across k roots instead of funnelling 2(k-1)x the payload
-    through rank 0 — on localhost/DCN the per-process socket loop is the
-    bottleneck, so multi-root striping is a ~kx bandwidth win WHEN the
-    host has cores to run the concurrent walks. On a 1-2 core host the
-    k root walks time-slice one CPU and the context switching costs more
-    than the striping saves (measured 2.5x slower than a single tree at
-    np=4 on 1 vCPU), so prefer one binary tree there — counting cores
-    the cgroup-aware way (effective_cpu_count), since a CPU-quota'd
-    container reports the host's cores while scheduling only a few.
-    Pair 0 is rank-0-rooted, preserving the gather/broadcast root
-    contract. Multi-host: one binary-tree-star per host master (same
-    striping argument across hosts)."""
+    """Single host, k >= 4: RING_SEGMENTED — the bandwidth-optimal
+    segmented reduce-scatter/all-gather moves only 2*(k-1)/k of the
+    payload per peer (tree/star roots carry ~2*(k-1)x), and its walk is
+    sequential per peer so it needs no spare cores for concurrent chunk
+    walks (unlike CLIQUE striping, which loses on 1-2 core hosts).
+    k == 3: segmented saves little (2/3 vs full relays through a 3-node
+    tree are close) and costs 4 serialized latency steps, so keep the
+    old striping-vs-tree core-count choice. k <= 2: STAR (one hop).
+    Pair 0 of every generated list stays rank-0-rooted, preserving the
+    gather/broadcast root contract. Multi-host: one binary-tree-star per
+    host master (striping across hosts; the hierarchical path owns the
+    cross-host segmented variant)."""
     if peers.host_count() == 1:
         if len(peers) <= 2:
             return Strategy.STAR
+        if len(peers) >= 4:
+            return Strategy.RING_SEGMENTED
         return (
             Strategy.CLIQUE
             if effective_cpu_count() >= 4
@@ -167,6 +168,12 @@ _GENERATORS = {
     Strategy.BINARY_TREE: _binary_tree,
     Strategy.BINARY_TREE_STAR: _binary_tree_star,
     Strategy.MULTI_BINARY_TREE_STAR: _multi_binary_tree_star,
+    # RING_SEGMENTED's allreduce runs the engine's dedicated segmented
+    # walk (host_session._run_segmented), not these graphs. The pair here
+    # backs the RESIDUAL graph ops (reduce/broadcast/gather, and tiny
+    # payloads below the segmentation threshold): a rank-0 binary tree —
+    # latency-optimal for the small control collectives that hit it.
+    Strategy.RING_SEGMENTED: _binary_tree,
 }
 
 

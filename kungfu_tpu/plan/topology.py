@@ -12,6 +12,7 @@ edges cross the network — on TPU pods this is the DCN between VM hosts.
 
 from __future__ import annotations
 
+import dataclasses
 from typing import List, Tuple
 
 from kungfu_tpu.plan.graph import Graph
@@ -128,6 +129,67 @@ def gen_subset_circular_graph_pair(n: int, ranks: List[int], r: int) -> Tuple[Gr
         reduce_g.add_edge(ranks[(r + i) % k], ranks[(r + i + 1) % k])
         bcast_g.add_edge(ranks[(r + i - 1) % k], ranks[(r + i) % k])
     return reduce_g, bcast_g
+
+
+@dataclasses.dataclass(frozen=True)
+class SegmentedSchedule:
+    """Per-rank plan for a segmented ring allreduce over ``ranks``.
+
+    The payload is split into ``k = len(ranks)`` contiguous segments.
+    Phase 1 (reduce-scatter) runs k-1 steps; at each step every member
+    sends one partially-reduced segment to its ring successor and
+    accumulates the segment arriving from its predecessor. After it,
+    member i holds the fully reduced segment ``(i+1) % k``. Phase 2
+    (all-gather) runs k-1 more steps relaying reduced segments around the
+    same ring. Every member therefore moves exactly
+    ``2 * (sum of all segments except one)`` ≈ ``2*(k-1)/k * N`` bytes
+    each way — the bandwidth-optimal schedule (arXiv:1810.11112 §3).
+
+    ``rs_steps``/``ag_steps`` are (send_segment, recv_segment) pairs; the
+    send/recv peers are fixed for the whole walk (ring successor and
+    predecessor in ``ranks`` order).
+    """
+
+    ranks: Tuple[int, ...]  # participating global ranks in ring order
+    index: int  # this member's position within ranks
+    rs_steps: Tuple[Tuple[int, int], ...]
+    ag_steps: Tuple[Tuple[int, int], ...]
+
+    @property
+    def k(self) -> int:
+        return len(self.ranks)
+
+    @property
+    def send_peer(self) -> int:
+        """Global rank of the ring successor (all sends go here)."""
+        return self.ranks[(self.index + 1) % self.k]
+
+    @property
+    def recv_peer(self) -> int:
+        """Global rank of the ring predecessor (all receives come from here)."""
+        return self.ranks[(self.index - 1) % self.k]
+
+    @property
+    def owned_segment(self) -> int:
+        """Segment this member holds fully reduced after reduce-scatter."""
+        return (self.index + 1) % self.k
+
+
+def gen_segmented_schedule(ranks: List[int], index: int) -> SegmentedSchedule:
+    """Segmented ring schedule for member ``index`` of ``ranks``.
+
+    Every member computes its own table from the same (ranks, k) inputs,
+    so the tables pair up cluster-wide without negotiation: the segment
+    member i sends at step s is exactly the segment member i+1 expects to
+    receive at step s (both phases).
+    """
+    k = len(ranks)
+    if not 0 <= index < k:
+        raise ValueError(f"index {index} outside ring of {k}")
+    i = index
+    rs = tuple(((i - s) % k, (i - s - 1) % k) for s in range(k - 1))
+    ag = tuple(((i + 1 - s) % k, (i - s) % k) for s in range(k - 1))
+    return SegmentedSchedule(ranks=tuple(ranks), index=i, rs_steps=rs, ag_steps=ag)
 
 
 def gen_subset_binary_tree(n: int, ranks: List[int]) -> Graph:

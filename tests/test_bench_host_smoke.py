@@ -1,0 +1,44 @@
+"""Tier-1 smoke for the HOST bench A/B flag (ISSUE 4 satellite): the
+tree/segmented paths must both run end-to-end under kfrun at tiny sizes
+and report throughput + per-peer wire bytes, so the A/B tooling (and the
+segmented engine behind it) can't silently rot."""
+
+import os
+import subprocess
+import sys
+
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+AGENT = os.path.join(REPO, "tests", "integration", "bench_host_agent.py")
+
+
+@pytest.mark.parametrize("algo", ["tree", "segmented"])
+def test_bench_host_ab_smoke(algo):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    # tiny payloads sit below the segmentation threshold; drop it so the
+    # segmented leg actually walks rs/ag steps (cluster-agreed via the
+    # runner env)
+    env["KF_CONFIG_SEGMENT_MIN_BYTES"] = "0"
+    env["KF_BENCH_ALGO"] = algo
+    env["KF_BENCH_MODEL"] = "tiny"
+    env["KF_BENCH_ITERS"] = "2"
+    r = subprocess.run(
+        [
+            sys.executable, "-m", "kungfu_tpu.runner.cli",
+            "-np", "2", "-H", "127.0.0.1:2",
+            sys.executable, AGENT,
+        ],
+        env=env, capture_output=True, text=True, timeout=180, cwd=REPO,
+    )
+    assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
+    assert "RESULT:" in r.stdout, r.stdout
+    # the A/B must report per-peer wire bytes, labelled with the forced
+    # strategy family
+    want_label = "RING_SEGMENTED" if algo == "segmented" else "BINARY_TREE"
+    # worker stdout arrives prefixed with the runner's [rank/np] tag
+    wire_lines = [l for l in r.stdout.splitlines() if "WIRE " in l]
+    assert wire_lines, r.stdout
+    assert any(want_label in l for l in wire_lines), r.stdout

@@ -26,6 +26,9 @@ ALL_STRATEGIES = [
     Strategy.BINARY_TREE,
     Strategy.BINARY_TREE_STAR,
     Strategy.MULTI_BINARY_TREE_STAR,
+    # graph-pair FALLBACK for the segmented strategy (residual ops +
+    # tiny payloads); the allreduce itself runs the segmented walk
+    Strategy.RING_SEGMENTED,
 ]
 
 
@@ -64,10 +67,13 @@ def test_all_strategies_span(strategy, peers):
 
 
 def test_auto_select():
-    # multi-root striping when cores can run the concurrent walks; one
-    # tree on low-core hosts (context switches beat striping there)
+    # k >= 4 single host: the bandwidth-optimal segmented ring (its walk
+    # is sequential per peer, so it needs no spare cores); k == 3 keeps
+    # the striping-vs-tree core-count choice; k <= 2 one hop
+    assert st.auto_select(make_peers(("a", 4))) == Strategy.RING_SEGMENTED
+    assert st.auto_select(make_peers(("a", 8))) == Strategy.RING_SEGMENTED
     expect_multi = st.effective_cpu_count() >= 4
-    assert st.auto_select(make_peers(("a", 4))) == (
+    assert st.auto_select(make_peers(("a", 3))) == (
         Strategy.CLIQUE if expect_multi else Strategy.BINARY_TREE
     )
     assert st.auto_select(make_peers(("a", 2))) == Strategy.STAR
@@ -93,10 +99,13 @@ def test_cgroup_quota_v2(monkeypatch, tmp_path):
     # 150000/100000 = 1.5 cores of quota
     _point_cgroup_at(monkeypatch, tmp_path, v2="150000 100000\n")
     assert st._cgroup_cpu_quota() == pytest.approx(1.5)
-    # quota'd container must not pick CLIQUE on phantom cores
+    # quota'd container must not pick CLIQUE on phantom cores (k=3 is
+    # the size where the core-count choice still applies; k>=4 goes
+    # RING_SEGMENTED regardless of cores)
     monkeypatch.setattr(os, "cpu_count", lambda: 16)
     assert st.effective_cpu_count() == 1
-    assert st.auto_select(make_peers(("a", 4))) == Strategy.BINARY_TREE
+    assert st.auto_select(make_peers(("a", 3))) == Strategy.BINARY_TREE
+    assert st.auto_select(make_peers(("a", 4))) == Strategy.RING_SEGMENTED
 
 
 def test_cgroup_quota_v2_unlimited(monkeypatch, tmp_path):
