@@ -242,9 +242,17 @@ def check_interference() -> bool:
 
 
 def active_strategy() -> str:
-    """Name of the running strategy; "SET_TREE" under a set_tree override."""
-    s = get_default_peer().current_session().active_strategy()
-    return s.name if s is not None else "SET_TREE"
+    """Name of the running adaptive candidate: the strategy, suffixed
+    with "/<codec>" when a wire codec is active (candidates are
+    (strategy, codec) pairs — an interference vote may have toggled
+    compression rather than the graphs); "SET_TREE" under a set_tree
+    override."""
+    sess = get_default_peer().current_session()
+    s = sess.active_strategy()
+    if s is None:
+        return "SET_TREE"
+    wire = sess._active_wire_mode()
+    return s.name if wire == "off" else f"{s.name}/{wire}"
 
 
 def calc_stats() -> dict:

@@ -47,6 +47,36 @@ try:
 except AttributeError:
     has_transform_n = False
 
+# Same guard for the wire-codec kernels (f32 <-> bf16/f16 converters and
+# the fused decode-accumulate): a stale .so degrades to the numpy codec
+# in ops.py, not to an AttributeError mid-collective.
+try:
+    _lib.kf_encode_wire.restype = ctypes.c_int
+    _lib.kf_encode_wire.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    _lib.kf_decode_wire.restype = ctypes.c_int
+    _lib.kf_decode_wire.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int32,
+    ]
+    _lib.kf_decode_accumulate.restype = ctypes.c_int
+    _lib.kf_decode_accumulate.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    has_wire_codec = True
+except AttributeError:
+    has_wire_codec = False
+
 
 def supported(dtype) -> bool:
     try:
@@ -86,3 +116,34 @@ def transform_n(dst: np.ndarray, srcs, op: int) -> None:
     rc = _lib.kf_transform_n(pd, ptrs, len(srcs), dst.size, int(dt), int(op))
     if rc != 0:
         raise ValueError(f"native transform_n unsupported: dtype={dt}, op={op}")
+
+
+def encode_wire(dst: np.ndarray, src: np.ndarray, wire: int) -> None:
+    """dst_u16 = encode(src_f32) to the wire dtype (DType.BF16/F16)."""
+    pd, ps = _ptr(dst), _ptr(src)
+    if pd is None or ps is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_encode_wire(pd, ps, src.size, int(wire))
+    if rc != 0:
+        raise ValueError(f"native encode_wire unsupported: wire={wire}")
+
+
+def decode_wire(dst: np.ndarray, src: np.ndarray, wire: int) -> None:
+    """dst_f32 = decode(src_u16) from the wire dtype."""
+    pd, ps = _ptr(dst), _ptr(src)
+    if pd is None or ps is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_decode_wire(pd, ps, src.size, int(wire))
+    if rc != 0:
+        raise ValueError(f"native decode_wire unsupported: wire={wire}")
+
+
+def decode_accumulate(acc: np.ndarray, src: np.ndarray, wire: int, op: int) -> None:
+    """acc_f32 = acc_f32 `op` decode(src_u16) — fused decode + reduce in
+    one pass over the segment (native/reduce.cpp kf_decode_accumulate)."""
+    pa, ps = _ptr(acc), _ptr(src)
+    if pa is None or ps is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_decode_accumulate(pa, ps, acc.size, int(wire), int(op))
+    if rc != 0:
+        raise ValueError(f"native decode_accumulate unsupported: wire={wire}, op={op}")

@@ -84,6 +84,90 @@ def copy_segment(
     np.copyto(dst[begin:end], incoming)
 
 
+# ---------------------------------------------------------------------------
+# wire codec: f32 payloads travel the host plane as bf16/f16
+# ---------------------------------------------------------------------------
+#
+# The collective engine encodes f32 workspaces to a 2-byte wire dtype
+# before the transport and accumulates every incoming segment into the
+# f32 buffer (fused decode+reduce), so each transmitted value is
+# quantized exactly once and no rounding ever happens in 16-bit storage.
+# Native kernels when built (kf_encode_wire / kf_decode_wire /
+# kf_decode_accumulate, guarded like kf_transform_n); the numpy fallback
+# is pure bit manipulation for bf16 (no ml_dtypes dependency) and astype
+# for f16 — both round to nearest-even, bit-matching the native path.
+
+from kungfu_tpu.base.dtype import DType
+
+WIRE_DTYPES = (DType.BF16, DType.F16)
+
+
+def _wire_native():
+    native = _load_native()
+    if native and getattr(native, "has_wire_codec", False):
+        return native
+    return None
+
+
+def _check_wire(wire: DType) -> None:
+    if wire not in WIRE_DTYPES:
+        raise ValueError(f"unsupported wire dtype: {wire!r}")
+
+
+def encode_wire(dst: np.ndarray, src: np.ndarray, wire: DType) -> None:
+    """dst_u16 = encode(src_f32): round-to-nearest-even narrowing to the
+    wire dtype. dst is a uint16 array of the same length as src."""
+    _check_wire(wire)
+    native = _wire_native()
+    if native is not None:
+        native.encode_wire(dst, src, int(wire))
+        return
+    if wire == DType.F16:
+        # overflow-to-inf is the codec contract (matches the native
+        # kernel); numpy warns on the cast, so silence just that
+        with np.errstate(over="ignore"):
+            dst[:] = src.astype(np.float16).view(np.uint16)
+        return
+    bits = src.view(np.uint32)
+    # bf16 fold with RNE: (bits + 0x7fff + lsb-of-result) >> 16
+    dst[:] = ((bits + np.uint32(0x7FFF) + ((bits >> np.uint32(16)) & np.uint32(1)))
+              >> np.uint32(16)).astype(np.uint16)
+
+
+def decode_wire(dst: np.ndarray, src: np.ndarray, wire: DType) -> None:
+    """dst_f32 = decode(src_u16): exact widening from the wire dtype."""
+    _check_wire(wire)
+    native = _wire_native()
+    if native is not None:
+        native.decode_wire(dst, src, int(wire))
+        return
+    if wire == DType.F16:
+        dst[:] = src.view(np.float16)
+        return
+    dst.view(np.uint32)[:] = src.astype(np.uint32) << np.uint32(16)
+
+
+def decode_accumulate(
+    acc: np.ndarray, begin: int, end: int, src: np.ndarray,
+    wire: DType, op: ReduceOp,
+) -> None:
+    """acc[begin:end] = acc[begin:end] `op` decode(src), in f32.
+
+    The per-step hot path of the compressed ring walk: the native kernel
+    fuses decode and reduce into one pass over the segment so the wire
+    payload is read once; the fallback decodes into a temporary then
+    reduces (two passes, still f32 accumulation)."""
+    _check_wire(wire)
+    seg = acc[begin:end]
+    native = _wire_native()
+    if native is not None:
+        native.decode_accumulate(seg, src, int(wire), int(op))
+        return
+    tmp = np.empty(seg.size, np.float32)
+    decode_wire(tmp, src, wire)
+    _NUMPY_OPS[op](seg, tmp, out=seg)
+
+
 def transform_n(dst: np.ndarray, srcs, op: ReduceOp) -> None:
     """dst = srcs[0] op srcs[1] op ... op srcs[k-1] in ONE memory pass
     (native kernel); dst must not alias any src. The k-1 pairwise

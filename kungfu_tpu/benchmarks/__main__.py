@@ -54,20 +54,96 @@ def bench_xla(model: str, iters: int, warmup: int = 3) -> None:
 
 
 def _wire_samples() -> dict:
-    """Per-(collective, strategy) wire-byte counter values for THIS
-    worker process (each worker owns its registry, so these are true
-    per-peer numbers — the in-process test suite only sees aggregates)."""
+    """Per-(collective, strategy, codec) wire-byte counter values for
+    THIS worker process (each worker owns its registry, so these are
+    true per-peer numbers — the in-process test suite only sees
+    aggregates)."""
     from kungfu_tpu.telemetry import metrics as tmetrics
 
     ctr = tmetrics.counter(
         "kungfu_collective_wire_bytes_total",
         "Host-plane collective payload bytes sent by this peer",
-        ("collective", "strategy"),
+        ("collective", "strategy", "codec"),
     )
     return {labels: value for _, labels, value in ctr.samples()}
 
 
-def bench_host(model: str, iters: int, warmup: int = 2) -> None:
+def _wire_saved() -> float:
+    """Total bytes the codec kept off the wire (this peer)."""
+    from kungfu_tpu.telemetry import metrics as tmetrics
+
+    ctr = tmetrics.counter(
+        "kungfu_collective_wire_saved_bytes_total",
+        "Wire bytes saved by the collective codec on this peer",
+        ("collective", "codec"),
+    )
+    return sum(value for _, _, value in ctr.samples())
+
+
+def bench_host_wire_ab(model: str, iters: int, warmup: int = 4) -> None:
+    """Paired same-process wire-codec A/B: measure `iters` with the
+    configured codec, then toggle the codec candidate IN-PLACE on every
+    worker (adaptive.advance() to candidate 1 — the same lockstep move
+    an interference vote makes) and measure `iters` again. Both legs
+    share one process, one session and one slice of box time, so
+    run-to-run scheduler drift — which on the shared bench box exceeds
+    the codec's win at resnet50 scale — cancels out of the ratio."""
+    from kungfu_tpu import api
+    from kungfu_tpu.models.fake import fake_gradients
+    from kungfu_tpu.peer import get_default_peer
+
+    grads = fake_gradients(model)
+    outs = [np.empty_like(g) for g in grads]
+    total_bytes = sum(g.nbytes for g in grads)
+    sess = get_default_peer().current_session()
+    legs: dict = {}
+    rounds = 8  # 4 alternating rounds per mode
+    per = max(2, iters // 4)
+    api.run_barrier()
+
+    def toggle() -> None:
+        # lockstep flip between candidates 0 and 1 — the same
+        # (strategy, codec-toggled) pair an interference vote would
+        # move to; deterministic on every peer, barrier'd so no walk
+        # straddles the flip (candidate 2+ would change the GRAPHS,
+        # which is not what this A/B measures)
+        sess.adaptive.active = 1 - sess.adaptive.active
+        api.run_barrier()
+
+    for i in range(warmup):
+        api.group_all_reduce_arrays(grads, name=f"wu:{i}", outs=outs)
+    for rnd in range(rounds):
+        mode = sess._active_wire_mode()
+        # one settle iteration after each flip: the first walk on a new
+        # wire format faults in its pooled staging sizes
+        api.group_all_reduce_arrays(grads, name=f"settle:{rnd}", outs=outs)
+        samples = legs.setdefault(mode, [])
+        for i in range(per):
+            t0 = time.perf_counter()
+            api.group_all_reduce_arrays(grads, name=f"ab:{rnd}:{i}", outs=outs)
+            samples.append(total_bytes / (time.perf_counter() - t0) / (1 << 30))
+        toggle()
+    if api.current_rank() == 0:
+        meds = {m: float(np.median(s)) for m, s in legs.items()}
+        for m, s in legs.items():
+            log.echo(
+                f"RESULT: {float(np.mean(s)):.3f} "
+                f"+-{float(1.96 * np.std(s)):.3f} (GiB/s) "
+                f"median {meds[m]:.3f} [HOST-AB wire={m}, "
+                f"x{api.cluster_size()} workers, {model}, "
+                f"{len(s)} interleaved samples]"
+            )
+        modes = list(meds)
+        if len(modes) == 2:
+            on = next((m for m in modes if m != "off"), modes[0])
+            off = "off" if "off" in meds else modes[1]
+            log.echo(
+                f"RESULT: wire={on} / wire={off} median speedup: "
+                f"{meds[on] / meds[off]:.2f}x [interleaved paired, {model}]"
+            )
+
+
+def bench_host(model: str, iters: int, warmup: int = 4) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
 
@@ -77,10 +153,14 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
     api.run_barrier()
     # warmup: connection + shm-arena setup and first-touch page faults
     # belong to session bring-up, not steady-state bandwidth (the XLA
-    # bench warms up identically)
+    # bench warms up identically). 4 rounds, not 2: the wire codec's
+    # pooled staging buffers (wire + encode scratches) are new exact-
+    # size pool bins whose first-touch ramp measurably lasts past 2
+    # iterations on the bench box
     for i in range(warmup):
         api.group_all_reduce_arrays(grads, name=f"warmup:{i}", outs=outs)
     wire_before = _wire_samples()
+    saved_before = _wire_saved()
     samples = []
     for i in range(iters):
         t0 = time.perf_counter()
@@ -88,6 +168,7 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
         dt = time.perf_counter() - t0
         samples.append(total_bytes / dt / (1 << 30))
     wire_after = _wire_samples()
+    saved = _wire_saved() - saved_before
     mean, err = float(np.mean(samples)), float(1.96 * np.std(samples))
     if api.current_rank() == 0:
         med = float(np.median(samples))
@@ -95,8 +176,10 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
             f"RESULT: {mean:.3f} +-{err:.3f} (GiB/s) median {med:.3f} "
             f"[HOST x{api.cluster_size()} workers, {model}]"
         )
-        # per-peer wire bytes (this rank): the A/B number behind the
-        # segmented engine — 2(k-1)/k x payload vs full-payload relays
+        # per-peer wire bytes (this rank): the A/B numbers behind the
+        # segmented engine (2(k-1)/k x payload vs full-payload relays)
+        # and the wire codec (a further /2 on compressed series); labels
+        # are (collective, strategy, codec)
         for labels, after in sorted(wire_after.items()):
             delta = after - wire_before.get(labels, 0.0)
             if delta <= 0:
@@ -106,9 +189,14 @@ def bench_host(model: str, iters: int, warmup: int = 2) -> None:
                 f"WIRE {labels}: {per_iter / (1 << 20):.1f} MiB/iter "
                 f"({per_iter / total_bytes:.2f}x payload)"
             )
+        if saved > 0:
+            log.echo(
+                f"WIRE saved by codec: {saved / iters / (1 << 20):.1f} "
+                f"MiB/iter ({saved / iters / total_bytes:.2f}x payload)"
+            )
         # where the time went (hot-path spans, this process only)
         summary = api.trace_summary()
-        top = sorted(summary.items(), key=lambda kv: -kv[1])[:6]
+        top = sorted(summary.items(), key=lambda kv: -kv[1])[:10]
         for name, ms in top:
             log.echo(f"TRACE {name}: {ms:.0f} ms")
 
@@ -216,12 +304,31 @@ def main() -> None:
         "(sets KF_CONFIG_ALGO before the session comes up; every worker "
         "runs the same argv so the override is cluster-agreed)",
     )
+    p.add_argument(
+        "--wire", choices=["off", "bf16", "f16", "auto"], default="",
+        help="HOST engine A/B: wire codec for f32 payloads (sets "
+        "KF_CONFIG_WIRE before the session comes up; cluster-agreed the "
+        "same way as --algo)",
+    )
+    p.add_argument(
+        "--wire-ab", action="store_true",
+        help="HOST only: paired same-process codec A/B — run --iters "
+        "with the --wire codec, toggle the codec candidate in lockstep "
+        "(the adaptive mechanism), run --iters again, report both "
+        "medians and the drift-free speedup ratio",
+    )
     args = p.parse_args()
+    if args.method != "HOST" and (args.algo or args.wire or args.wire_ab):
+        # the default method is XLA: silently measuring the wrong plane
+        # is worse than an error
+        p.error("--algo/--wire/--wire-ab only apply to --method HOST")
     if args.method == "HOST":
         import os
 
         if args.algo:
             os.environ["KF_CONFIG_ALGO"] = args.algo
+        if args.wire:
+            os.environ["KF_CONFIG_WIRE"] = args.wire
         # wire-byte accounting rides the metrics gate; the bench wants it
         # on regardless so the A/B always reports bytes per peer
         from kungfu_tpu.telemetry import config as tconfig
@@ -233,6 +340,8 @@ def main() -> None:
         bench_p2p(args.model, args.iters)
     elif args.method == "GNS":
         bench_gns(args.iters)
+    elif args.wire_ab:
+        bench_host_wire_ab(args.model, args.iters)
     else:
         bench_host(args.model, args.iters)
 

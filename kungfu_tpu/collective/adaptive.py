@@ -81,9 +81,13 @@ class AdaptiveState:
     exchanging the choice itself — only the vote count.
     """
 
-    def __init__(self, n_candidates: int):
+    def __init__(self, n_candidates: int, names: Optional[List[str]] = None):
         self.n_candidates = max(1, n_candidates)
         self.active = 0
+        # display names, e.g. "RING_SEGMENTED/bf16": candidates are
+        # (strategy, wire-codec) pairs since the codec joined the
+        # adaptive set — stats summaries label them for operators
+        self.names: List[str] = list(names or [])[: self.n_candidates]
         self.stats: List[StrategyStat] = [StrategyStat() for _ in range(self.n_candidates)]
         self.switch_count = 0
         self.last_switch_time: Optional[float] = None
@@ -101,8 +105,14 @@ class AdaptiveState:
         return self.active
 
     def summary(self) -> dict:
+        stats = []
+        for i, s in enumerate(self.stats):
+            d = s.summary()
+            if i < len(self.names):
+                d["candidate"] = self.names[i]
+            stats.append(d)
         return {
             "active": self.active,
             "switches": self.switch_count,
-            "stats": [s.summary() for s in self.stats],
+            "stats": stats,
         }

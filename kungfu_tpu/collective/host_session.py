@@ -23,9 +23,13 @@ from typing import Callable, Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 
+from kungfu_tpu.base.dtype import DType
 from kungfu_tpu.base.ops import (
     ReduceOp,
     copy_segment,
+    decode_accumulate,
+    decode_wire,
+    encode_wire,
     reduce_inplace,
     reduce_segment,
     transform_n,
@@ -80,6 +84,32 @@ def algo_override() -> Optional[Strategy]:
             f"KF_CONFIG_ALGO must be one of "
             f"{sorted(k for k in _ALGO_STRATEGY if k)}, got {raw!r}"
         ) from None
+
+
+# Wire codec (ISSUE 5 tentpole): f32 allreduce payloads travel the
+# transport as bf16/f16 while every reduce step accumulates into the f32
+# buffer. Like KF_CONFIG_ALGO this is a cluster-agreed runtime knob (it
+# decides message SIZES, so a disagreeing peer would read short/long
+# frames) — fail-fast enforced by check_knob_consensus at session start.
+# `auto` currently resolves to bf16 for eligible payloads (the TPU-native
+# format: f32-identical exponent range, so no overflow surprises); it is
+# a distinct mode so later heuristics (payload- or link-aware) can slot
+# in without an env change.
+_WIRE_MODES = ("off", "bf16", "f16", "auto")
+
+_WIRE_DTYPE = {"bf16": DType.BF16, "f16": DType.F16, "auto": DType.BF16}
+
+
+def wire_override() -> str:
+    """Parse KF_CONFIG_WIRE (read per session epoch, not import time)."""
+    raw = os.environ.get("KF_CONFIG_WIRE", "").strip().lower()
+    if raw == "":
+        return "off"
+    if raw not in _WIRE_MODES:
+        raise ValueError(
+            f"KF_CONFIG_WIRE must be one of {sorted(_WIRE_MODES)}, got {raw!r}"
+        )
+    return raw
 
 
 def choose_chunk_bytes(total: int) -> int:
@@ -152,6 +182,37 @@ def _buf(arr: np.ndarray):
         return arr.data.cast("B")
     except (ValueError, TypeError, AttributeError):
         return arr.tobytes()
+
+
+class _DeferredDecode:
+    """Handle to a compressed segmented walk's all-gather wire buffer,
+    returned instead of the walk-end f32 decode when the caller asked to
+    defer it (`_allreduce_ws(defer_decode=True)`). The fused pipeline's
+    unpacker decodes straight from this buffer into each member's recv —
+    fusing decode with unpack saves one full f32 pass over the bucket on
+    the hot path. Call `decode_into(dst, begin, end)` per member, then
+    `close()` exactly once to return the buffer to the pool."""
+
+    __slots__ = ("wire", "_buf", "_arr")
+
+    def __init__(self, wire: DType, buf, arr: np.ndarray):
+        self.wire = wire
+        self._buf = buf
+        self._arr = arr
+
+    def decode_into(self, dst: np.ndarray, begin: int, end: int) -> None:
+        seg = self._arr[begin:end]
+        if dst.flags["C_CONTIGUOUS"]:
+            decode_wire(dst, seg, self.wire)
+        else:
+            tmp = np.empty(end - begin, np.float32)
+            decode_wire(tmp, seg, self.wire)
+            np.copyto(dst, tmp)
+
+    def close(self) -> None:
+        if self._buf is not None:
+            get_buffer_pool().put(self._buf)
+            self._buf = None
 
 
 class _CollectiveScope:
@@ -229,21 +290,35 @@ class HostSession:
         # root != 0 regenerated star + default-reduce on every call);
         # sessions are rebuilt each epoch, so invalidation is automatic
         self._root_graphs: Dict[int, Tuple[Graph, Graph]] = {}
+        # wire codec knob: resolved once per session epoch like the
+        # strategy; the ACTIVE codec can differ when adaptation toggles it
+        self.wire_mode = wire_override()
         # adaptive control (parity: session/adaptiveStrategies.go): a
         # deterministic candidate order — identical on every peer — so a
-        # majority vote can advance everyone in lockstep. Candidate graph
-        # lists are built lazily: sessions are rebuilt every elastic epoch
-        # and most never adapt. RING_SEGMENTED sits first among the
-        # alternates so interference votes can switch ONTO the
-        # bandwidth-optimal member (and off it, by advancing again).
-        self._candidate_names = [strategy] + [
-            s for s in (
-                Strategy.RING_SEGMENTED, Strategy.RING,
-                Strategy.BINARY_TREE_STAR, Strategy.STAR, Strategy.CLIQUE,
-            ) if s != strategy
-        ]
-        self._candidates_built: dict = {0: self.global_strategies}
-        self.adaptive = AdaptiveState(len(self._candidate_names))
+        # majority vote can advance everyone in lockstep. Candidates are
+        # (strategy, wire-mode) pairs: the first alternate toggles the
+        # CODEC on the same graphs (the cheapest lever against a
+        # congested/interfered link — half or restore the wire bytes
+        # without re-pairing anyone), then the strategy alternates walk
+        # under the configured codec, RING_SEGMENTED first so votes can
+        # switch ONTO the bandwidth-optimal member (and off it, by
+        # advancing again). Candidate graph lists are built lazily:
+        # sessions are rebuilt every elastic epoch and most never adapt.
+        wire_toggled = "off" if self.wire_mode != "off" else "bf16"
+        self._candidates: List[Tuple[Strategy, str]] = (
+            [(strategy, self.wire_mode), (strategy, wire_toggled)]
+            + [
+                (s, self.wire_mode) for s in (
+                    Strategy.RING_SEGMENTED, Strategy.RING,
+                    Strategy.BINARY_TREE_STAR, Strategy.STAR, Strategy.CLIQUE,
+                ) if s != strategy
+            ]
+        )
+        self._candidates_built: dict = {0: self.global_strategies, 1: self.global_strategies}
+        self.adaptive = AdaptiveState(
+            len(self._candidates),
+            names=[f"{s.name}/{wm}" for s, wm in self._candidates],
+        )
         self._tree_override = False
         # per-collective latency histogram (telemetry): one observe per
         # COLLECTIVE call (not per message), gated off with the rest of
@@ -258,24 +333,39 @@ class HostSession:
             else None
         )
         # wire-byte accounting: bytes this peer SENDS into collective
-        # walks, by (public collective, executing strategy). This is the
-        # counter the segmented engine's bandwidth-optimality claim is
-        # asserted against (tests) and the A/B bench reports.
+        # walks, by (public collective, executing strategy, wire codec).
+        # This is the counter the segmented engine's bandwidth-optimality
+        # claim is asserted against (tests) and the A/B bench reports;
+        # the codec dimension separates compressed from raw traffic.
         self._wire_ctr = (
             tmetrics.counter(
                 "kungfu_collective_wire_bytes_total",
                 "Host-plane collective payload bytes sent by this peer",
-                ("collective", "strategy"),
+                ("collective", "strategy", "codec"),
+            )
+            if tconfig.metrics_enabled()
+            else None
+        )
+        # bytes the codec kept OFF the wire: raw payload minus encoded
+        # payload, summed over every compressed send
+        self._wire_saved_ctr = (
+            tmetrics.counter(
+                "kungfu_collective_wire_saved_bytes_total",
+                "Wire bytes saved by the collective codec on this peer",
+                ("collective", "codec"),
             )
             if tconfig.metrics_enabled()
             else None
         )
         self._wire_kind = "raw"
+        # audit dedup for codec bypasses: one event per (reason, dtype)
+        # per session epoch, so consensus lanes don't flood the audit log
+        self._codec_bypass_seen: set = set()
 
     def _candidate(self, idx: int) -> List[st.StrategyPair]:
         if idx not in self._candidates_built:
             self._candidates_built[idx] = st.gen_global_strategies(
-                self.peers, self._candidate_names[idx]
+                self.peers, self._candidates[idx][0]
             )
         return self._candidates_built[idx]
 
@@ -292,9 +382,20 @@ class HostSession:
         metrics are on. Returns a context manager."""
         return _CollectiveScope(self, kind, nbytes)
 
-    def _count_wire(self, nbytes: int, strategy_label: str) -> None:
+    def _count_wire(
+        self, nbytes: int, strategy_label: str, codec: str = "off",
+        raw_bytes: int = 0,
+    ) -> None:
         if self._wire_ctr is not None and nbytes:
-            self._wire_ctr.labels(self._wire_kind, strategy_label).inc(nbytes)
+            self._wire_ctr.labels(self._wire_kind, strategy_label, codec).inc(nbytes)
+        if (
+            self._wire_saved_ctr is not None
+            and codec != "off"
+            and raw_bytes > nbytes
+        ):
+            self._wire_saved_ctr.labels(self._wire_kind, codec).inc(
+                raw_bytes - nbytes
+            )
 
     def _walk_label(self) -> str:
         """Strategy label for graph-walk wire accounting. Labels the
@@ -304,10 +405,57 @@ class HostSession:
         series (it is the one the optimality assertion reads)."""
         if self._tree_override:
             return "SET_TREE"
-        active = self._candidate_names[self.adaptive.active]
+        active = self._candidates[self.adaptive.active][0]
         if active == Strategy.RING_SEGMENTED:
             return Strategy.BINARY_TREE.name
         return active.name
+
+    def _active_wire_mode(self) -> str:
+        """The RUNNING codec mode: the active adaptive candidate's wire
+        member, or the configured mode under a set_tree override (an
+        explicit forest replaces the graphs, not the codec)."""
+        if self._tree_override:
+            return self.wire_mode
+        return self._candidates[self.adaptive.active][1]
+
+    def _codec_bypass(self, reason: str, w: Workspace) -> None:
+        """Audit (once per (reason, dtype) per session epoch) that a
+        workspace bypassed an enabled codec — exact semantics preserved
+        for consensus lanes, variance probes and tiny residuals."""
+        key = (reason, w.send.dtype.str)
+        if key in self._codec_bypass_seen:
+            return
+        self._codec_bypass_seen.add(key)
+        from kungfu_tpu.telemetry import audit as _audit
+
+        _audit.record_event(
+            "wire_codec_bypass",
+            peer=str(self.self_id),
+            reason=reason,
+            dtype=w.send.dtype.str,
+            name=w.name,
+            nbytes=int(w.recv.nbytes),
+        )
+
+    def _wire_codec_for(self, w: Workspace) -> Optional[DType]:
+        """Codec decision for one allreduce workspace, or None (raw).
+
+        MUST depend only on cluster-agreed inputs — the resolved wire
+        mode (env + lockstep adaptive votes) and workspace properties
+        identical on every peer — because it decides the byte count of
+        every message in the walk. Non-f32 payloads (consensus lanes,
+        int gradients) and sub-WIRE_MIN_BYTES residuals bypass with an
+        audit event, never an error."""
+        mode = self._active_wire_mode()
+        if mode == "off":
+            return None
+        if w.send.dtype != np.float32:
+            self._codec_bypass("non_f32", w)
+            return None
+        if w.recv.nbytes < self.WIRE_MIN_BYTES:
+            self._codec_bypass("below_min_bytes", w)
+            return None
+        return _WIRE_DTYPE[mode]
 
     def _recv_collective(
         self, peer: PeerID, name: str, nbytes: int, dtype, count: int,
@@ -345,26 +493,45 @@ class HostSession:
         os.environ.get("KF_CONFIG_SEGMENT_MIN_BYTES", "") or (64 << 10)
     )
 
+    # Codec floor: encoding pays two passes (encode + decode) to halve
+    # the wire bytes, which only wins once the payload dwarfs the fixed
+    # per-walk costs; tiny control collectives also stay exact this way.
+    # Cluster-agreed like SEGMENT_MIN_BYTES (it decides message sizes).
+    WIRE_MIN_BYTES = int(
+        os.environ.get("KF_CONFIG_WIRE_MIN_BYTES", "") or (64 << 10)
+    )
+
     def _segmented_active(self) -> bool:
         return (
             not self._tree_override
             and self.size >= 2
-            and self._candidate_names[self.adaptive.active]
+            and self._candidates[self.adaptive.active][0]
             == Strategy.RING_SEGMENTED
         )
 
     def _allreduce_ws(
-        self, w: Workspace, cancel: Optional[threading.Event] = None
-    ) -> None:
+        self,
+        w: Workspace,
+        cancel: Optional[threading.Event] = None,
+        defer_decode: bool = False,
+    ) -> Optional[_DeferredDecode]:
         """Engine dispatch for one allreduce workspace: the segmented
         ring walk when RING_SEGMENTED is active and the payload is worth
         segmenting, else chunked graph walks. `cancel` (group/window
         scope) propagates so an abandoned walk observes the caller's
-        timeout before mutating recv buffers."""
+        timeout before mutating recv buffers.
+
+        With `defer_decode=True` a compressed segmented walk skips its
+        walk-end decode and returns the wire buffer as a
+        _DeferredDecode (w.recv is then NOT fully written!); every
+        other path returns None and w.recv holds the result."""
+        wire = self._wire_codec_for(w)
         if self._segmented_active() and w.recv.nbytes >= self.SEGMENT_MIN_BYTES:
-            self._run_segmented(w, cancel=cancel)
-        else:
-            self._run_strategies(w, self.global_strategies, cancel)
+            return self._run_segmented(
+                w, cancel=cancel, wire=wire, defer_decode=defer_decode
+            )
+        self._run_strategies(w, self.global_strategies, cancel, wire=wire)
+        return None
 
     def all_reduce(self, w: Workspace) -> None:
         with self._collected("all_reduce", w.recv.nbytes):
@@ -493,17 +660,30 @@ class HostSession:
     def _pack_bucket(self, bi: int, members: List[Workspace]):
         """Pack one bucket into pooled contiguous buffers. Workspace
         order is the caller's tensor order, identical on every peer, so
-        the fused name and layout agree cluster-wide."""
+        the fused name and layout agree cluster-wide.
+
+        When the wire codec will compress this bucket, members are
+        packed straight into ONE buffer that doubles as the walk's f32
+        accumulator (an inplace workspace): all wire staging already
+        happens in pooled 2-byte scratches inside the walk, so the
+        second full-size f32 buffer (and its memcpy) of the raw path
+        buys nothing. Inplace fused workspaces are valid on every walk
+        path, so a mid-flight adaptive codec toggle stays correct."""
         dtype = members[0].send.dtype
         op = members[0].op
         total = sum(w.send.size for w in members)
         nbytes = total * dtype.itemsize
         pool = get_buffer_pool()
+        single = (
+            self._active_wire_mode() != "off"
+            and dtype == np.float32
+            and nbytes >= self.WIRE_MIN_BYTES
+        )
         send_b = pool.get(nbytes)
-        recv_b = pool.get(nbytes)
+        recv_b = None if single else pool.get(nbytes)
         with trace.span("host.fuse.pack"):
             send = np.frombuffer(send_b, dtype, total)
-            recv = np.frombuffer(recv_b, dtype, total)
+            recv = send if single else np.frombuffer(recv_b, dtype, total)
             off = 0
             for w in members:
                 send[off : off + w.send.size] = w.send
@@ -517,17 +697,28 @@ class HostSession:
         return (fused, send_b, recv_b, members)
 
     def _unpack_bucket(self, item) -> None:
-        fused, send_b, recv_b, members = item
+        fused, send_b, recv_b, members, deferred = item
         pool = get_buffer_pool()
         try:
             with trace.span("host.fuse.unpack"):
                 off = 0
-                for w in members:
-                    np.copyto(w.recv, fused.recv[off : off + w.recv.size])
-                    off += w.recv.size
+                if deferred is not None:
+                    # fused decode+unpack: the compressed walk handed us
+                    # its wire buffer instead of decoding into the fused
+                    # recv first — one full f32 pass saved per bucket
+                    for w in members:
+                        deferred.decode_into(w.recv, off, off + w.recv.size)
+                        off += w.recv.size
+                else:
+                    for w in members:
+                        np.copyto(w.recv, fused.recv[off : off + w.recv.size])
+                        off += w.recv.size
         finally:
+            if deferred is not None:
+                deferred.close()
             pool.put(send_b)
-            pool.put(recv_b)
+            if recv_b is not None:
+                pool.put(recv_b)
 
     def _fused_pipeline(
         self,
@@ -596,8 +787,14 @@ class HostSession:
                     if abort.is_set():
                         continue  # drain to the sentinel
                     with trace.span("host.fuse.walk"):
-                        self._allreduce_ws(item[0])
-                    if not put(unpackq, item):
+                        # defer the codec's walk-end decode to the
+                        # unpacker, which fuses it with the member
+                        # scatter (an aborted in-flight wire buffer is
+                        # dropped to GC like every other staging buffer)
+                        deferred = self._allreduce_ws(
+                            item[0], defer_decode=True
+                        )
+                    if not put(unpackq, item + (deferred,)):
                         return
             except BaseException:
                 abort.set()
@@ -623,7 +820,17 @@ class HostSession:
     def monitored_all_reduce(self, w: Workspace) -> None:
         """AllReduce + throughput accounting for the ACTIVE strategy
         (parity: KungfuMonitoredAllReduce, ops/cpu/collective.cpp:149-196 +
-        runMonitoredStrategies, session/monitoring.go:15-35)."""
+        runMonitoredStrategies, session/monitoring.go:15-35).
+
+        Runs the active candidate's wire format like all_reduce — this
+        is the ONLY site feeding adaptive.current, so it MUST measure
+        what the candidate actually does or codec candidates would
+        accumulate raw-walk stats and interference votes could never
+        observe compression. Probe-style traffic keeps exact semantics
+        through the codec's own gates: non-f32 lanes and payloads under
+        WIRE_MIN_BYTES always bypass (audited), and the gradient-
+        variance/noise-scale monitors are on-device psums that never
+        touch the host plane at all."""
         nbytes = w.recv.size * w.recv.itemsize
         t0 = time.perf_counter()
         with self._collected("monitored_all_reduce", nbytes):
@@ -637,7 +844,7 @@ class HostSession:
         same deterministic order. Returns True if the strategy switched.
         Parity: CheckInterference + MonitoredAllReduce consensus switch
         (session/adaptiveStrategies.go:61-121)."""
-        if self._tree_override or len(self._candidate_names) < 2:
+        if self._tree_override or len(self._candidates) < 2:
             return False
         suspect = self.adaptive.current.suspect_interference()
         votes_in = np.array([1 if suspect else 0], np.int32)
@@ -648,12 +855,15 @@ class HostSession:
         )
         if int(votes_out[0]) * 2 <= self.size:
             return False
-        old_name = self._candidate_names[self.adaptive.active].name
+        old_strategy, old_wire = self._candidates[self.adaptive.active]
         idx = self.adaptive.advance()
         self.global_strategies = self._candidate(idx)
-        # safety: all peers must now run the same graphs
+        new_strategy, new_wire = self._candidates[idx]
+        # safety: all peers must now run the same graphs AND wire format
+        # (a codec split would desync every message size in the walk)
         if not self.bytes_consensus(
-            st.digest(self.global_strategies), f":switch:{self.adaptive.switch_count}"
+            st.digest(self.global_strategies) + new_wire.encode(),
+            f":switch:{self.adaptive.switch_count}",
         ):
             raise RuntimeError("strategy switch diverged across peers")
         from kungfu_tpu.telemetry import audit as _audit
@@ -662,8 +872,10 @@ class HostSession:
             "strategy_switch",
             peer=str(self.self_id),
             trigger="interference_vote",
-            old_strategy=old_name,
-            new_strategy=self._candidate_names[idx].name,
+            old_strategy=old_strategy.name,
+            new_strategy=new_strategy.name,
+            old_wire=old_wire,
+            new_wire=new_wire,
             switch_count=self.adaptive.switch_count,
         )
         return True
@@ -673,7 +885,7 @@ class HostSession:
         set_tree forest overrides the candidates."""
         if self._tree_override:
             return None
-        return self._candidate_names[self.adaptive.active]
+        return self._candidates[self.adaptive.active][0]
 
     def set_tree(self, fathers: Sequence[int]) -> None:
         """Install a runtime forest (e.g. an MST over probed latencies) as
@@ -707,16 +919,22 @@ class HostSession:
         forward. Gated on _segmented_active — not the static configured
         strategy — so set_tree overrides and adaptive switches govern the
         cross path exactly like the global one (votes advance in lockstep
-        on every peer, so the gate stays cluster-consistent)."""
+        on every peer, so the gate stays cluster-consistent).
+
+        The wire codec applies here like the global allreduce — the
+        cross-host hop crosses the DCN, exactly where halving wire
+        bytes pays most; the intra-host reduce/broadcast phases around
+        it stay raw (loopback/shm, nothing to save)."""
+        wire = self._wire_codec_for(w)
         with stall_detect(f"cross_all_reduce({w.name})"):
             if (
                 self._segmented_active()
                 and len(self._masters) >= 2
                 and w.recv.nbytes >= self.SEGMENT_MIN_BYTES
             ):
-                self._run_segmented(w, ranks=self._masters)
+                self._run_segmented(w, ranks=self._masters, wire=wire)
             else:
-                self._run_strategies(w, self.cross_strategies)
+                self._run_strategies(w, self.cross_strategies, wire=wire)
 
     def local_reduce(self, w: Workspace) -> None:
         self._run_graphs(w, [self.local_strategies[0].reduce_graph])
@@ -790,13 +1008,23 @@ class HostSession:
         MIN-allreduce of the two-lane (payload, 255-payload) bytes yields
         (elementwise-min, 255-elementwise-max) in another — consensus iff
         min == max in both. Every elastic resize and strategy switch pays
-        this path, so halving the rounds halves its serialized latency."""
+        this path, so halving the rounds halves its serialized latency.
+
+        Runs int64/uint8 lanes through the regular engine — the wire
+        codec is f32-only, so consensus payloads are never quantized
+        (docs/collectives.md: consensus MUST stay exact)."""
+        return self._bytes_agree(bs, name, self.all_reduce)
+
+    def _bytes_agree(
+        self, bs: bytes, name: str, run: Callable[[Workspace], None]
+    ) -> bool:
+        """The 2-round consensus algebra, parameterized over the
+        allreduce runner so the knob-consensus check can use graphs that
+        do not depend on the very knobs being checked."""
         n = len(bs)
         lens = np.array([n, -n], np.int64)
         out_len = np.zeros(2, np.int64)
-        self.all_reduce(
-            Workspace(lens, out_len, ReduceOp.MIN, f":consensus:len:{name}")
-        )
+        run(Workspace(lens, out_len, ReduceOp.MIN, f":consensus:len:{name}"))
         if out_len[0] != -out_len[1]:
             return False
         if n == 0:
@@ -806,10 +1034,72 @@ class HostSession:
         lanes[:n] = x
         np.subtract(255, x, out=lanes[n:])
         out = np.zeros(2 * n, np.uint8)
-        self.all_reduce(
-            Workspace(lanes, out, ReduceOp.MIN, f":consensus:data:{name}")
-        )
+        run(Workspace(lanes, out, ReduceOp.MIN, f":consensus:data:{name}"))
         return bool(np.array_equal(out[:n], 255 - out[n:]))
+
+    # ------------------------------------------------------------------
+    # engine-knob consensus (fail fast instead of deadlocking)
+    # ------------------------------------------------------------------
+
+    def engine_knobs(self) -> List[Tuple[str, str]]:
+        """The cluster-agreed engine knobs, as resolved BY THIS SESSION.
+
+        Every entry decides rendezvous names, message sizes or peer
+        pairings, so peers that resolved different values would wait on
+        each other's names (or mis-frame messages) forever. Local-only
+        tuning (KF_CONFIG_GROUP_WINDOW — pure intra-host concurrency) is
+        deliberately excluded: it may legitimately differ per host."""
+        return [
+            ("KF_CONFIG_ALGO",
+             os.environ.get("KF_CONFIG_ALGO", "").strip().lower()),
+            ("KF_CONFIG_CHUNK_BYTES", str(CHUNK_BYTES)),
+            ("KF_CONFIG_SEGMENT_MIN_BYTES", str(self.SEGMENT_MIN_BYTES)),
+            ("KF_CONFIG_GROUP_BUCKET_BYTES", str(self.GROUP_BUCKET_BYTES)),
+            ("KF_CONFIG_GROUP_FUSE_MIN", str(self.FUSE_MIN_TENSORS)),
+            ("KF_CONFIG_WIRE", self.wire_mode),
+            ("KF_CONFIG_WIRE_MIN_BYTES", str(self.WIRE_MIN_BYTES)),
+        ]
+
+    def _fixed_allreduce(self, w: Workspace) -> None:
+        """Allreduce over a rank-0 star, unchunked and uncompressed — a
+        walk whose rendezvous names and message sizes depend on NOTHING
+        the knobs control, so it completes even across knob-divergent
+        peers (tiny payloads; latency is 2 serialized hops)."""
+        bcast, red = self._root_star_graphs(0)
+        self._run_graphs(w, [red, bcast])
+
+    def check_knob_consensus(self) -> None:
+        """Fail fast on engine-knob divergence (satellite of ISSUE 5).
+
+        Without this, peers that resolved different KF_CONFIG_ALGO /
+        CHUNK_BYTES / GROUP_BUCKET_BYTES / WIRE values wait on each
+        other's rendezvous names forever — the first collective of the
+        epoch just hangs. One consensus over the resolved knob tuple at
+        session start turns that into an immediate, named error. Runs on
+        the knob-independent star walk, so the check itself cannot
+        deadlock on the very disagreement it detects; on mismatch a
+        per-knob round pins down WHICH knob diverged."""
+        if self.size < 2:
+            return
+        knobs = self.engine_knobs()
+        blob = ";".join(f"{k}={v}" for k, v in knobs).encode()
+        if self._bytes_agree(blob, ":knobs", self._fixed_allreduce):
+            return
+        bad = [
+            k for k, v in knobs
+            if not self._bytes_agree(
+                v.encode(), f":knob:{k}", self._fixed_allreduce
+            )
+        ]
+        mine = dict(knobs)
+        names = ", ".join(bad) if bad else "engine knob tuple"
+        raise RuntimeError(
+            f"engine knob mismatch across peers: {names} — these KF_CONFIG_* "
+            f"values decide rendezvous names and message sizes, so they MUST "
+            f"be set identically fleet-wide (collectives would deadlock); "
+            f"this peer ({self.self_id}) resolved "
+            + ", ".join(f"{k}={mine[k]!r}" for k in (bad or mine))
+        )
 
     def broadcast_bytes(self, bs: bytes, name: str, root: int = 0) -> bytes:
         """Broadcast variable-length bytes from `root` (two graph walks:
@@ -915,7 +1205,9 @@ class HostSession:
         w: Workspace,
         ranks: Optional[Sequence[int]] = None,
         cancel: Optional[threading.Event] = None,
-    ) -> None:
+        wire: Optional[DType] = None,
+        defer_decode: bool = False,
+    ) -> Optional[_DeferredDecode]:
         """Bandwidth-optimal segmented walk: a (k-1)-step reduce-scatter
         over contiguous segments followed by a (k-1)-step all-gather
         around a ring (arXiv:1810.11112 §3; the TPU-pod MLPerf stack
@@ -924,6 +1216,20 @@ class HostSession:
         (or, in the gather phase, copies) the segment arriving from the
         predecessor in place — zero-copy views into the recv buffer, no
         full-payload relays, ~2*(k-1)/k*N bytes moved per peer total.
+
+        With `wire` set (the codec, ISSUE 5) each segment crosses the
+        transport as bf16/f16 — half the bytes, 2*(k-1)/k*N/2 per peer:
+
+        * reduce-scatter: the sender encodes its f32 partial into a
+          pooled wire scratch; the receiver decode-accumulates into the
+          f32 buffer in one fused pass, so every transmitted value is
+          quantized exactly once and no rounding compounds in 16-bit
+          storage across the (k-1) steps;
+        * all-gather: segments STAY in wire dtype in a walk-local wire
+          buffer — each already-reduced segment is quantized once by its
+          owner, relayed untouched, and decoded exactly once per peer at
+          walk end (the owner decodes its own encoding too, so every
+          peer lands on bit-identical results).
 
         Contracts shared with the graph walk: receives prefer the
         zero-copy sink/shm-borrow path (`recv_into`) and release borrows
@@ -934,15 +1240,17 @@ class HostSession:
         every edge, so no peer waits on a message that never departs.
 
         `ranks` restricts the ring to a subset (hierarchical cross-host
-        mode); non-members just forward send into recv."""
+        mode); non-members just forward send into recv. With
+        `defer_decode` (compressed walks only) the walk-end decode is
+        skipped and the wire buffer returned — see _DeferredDecode."""
         if w.is_empty:
             w.forward()
-            return
+            return None
         members = list(range(self.size)) if ranks is None else list(ranks)
         k = len(members)
         if self.rank not in members or k == 1:
             w.forward()
-            return
+            return None
         sched = topo.gen_segmented_schedule(members, members.index(self.rank))
         bounds = even_partition(w.recv.size, k)
         w.forward()  # seed the accumulator with own contribution
@@ -950,19 +1258,31 @@ class HostSession:
         send_peer = self.peers[sched.send_peer]
         recv_peer = self.peers[sched.recv_peer]
         itemsize = acc.itemsize
+        wire_itemsize = 2 if wire is not None else itemsize
+        codec_label = wire.name.lower() if wire is not None else "off"
         bufpool = get_buffer_pool()
         deadline = time.monotonic() + self.timeout
-        wire = 0
+        wire_bytes = 0
+        raw_bytes = 0
+        # all-gather wire buffer: segments stay encoded here from the
+        # owner's single quantization until the walk-end decode. Leaked
+        # (not pool-returned) on any error — the transport may still be
+        # mid-fill into a timed-out sink slice.
+        wirebuf: Optional[bytearray] = None
+        wirearr: Optional[np.ndarray] = None
+        if wire is not None:
+            wirebuf = bufpool.get(acc.size * 2)
+            wirearr = np.frombuffer(wirebuf, np.uint16, acc.size)
 
-        def do_send(name: str, sb: int, se: int) -> None:
+        def do_send(name: str, sb: int, se: int, buf) -> None:
             """Deadline-bounded send: a frozen successor (full shm ring
             -> socket fallback -> full TCP buffer) would otherwise block
             sendall forever and the walk-wide deadline — checked only in
             do_recv — would never fire. Dispatch + event-wait costs tens
             of µs per step, noise against the segment memcpy. A timed-out
             send thread is abandoned exactly like the graph walk's _par
-            send threads; the zero-copy view stays valid because the
-            caller raises out of the walk without touching acc again."""
+            send threads; the buffer stays valid because the caller
+            raises out of the walk without touching acc again."""
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(f"segmented walk timed out: {name}")
@@ -975,7 +1295,7 @@ class HostSession:
                     # sequential per workspace, so this view cannot be
                     # mutated mid-sendall
                     self.client.send(
-                        send_peer, name, _buf(acc[sb:se]), ConnType.COLLECTIVE
+                        send_peer, name, _buf(buf), ConnType.COLLECTIVE
                     )
                 except BaseException as e:  # noqa: BLE001 - re-raised below
                     errs.append(e)
@@ -988,12 +1308,58 @@ class HostSession:
             if errs:
                 raise errs[0]
 
-        def do_recv(name: str, rb: int, re_: int, reducing: bool) -> None:
+        def start_send_wire(name: str, sb: int, se: int, buf):
+            """Async wire-mode send: encode (when `buf` is an f32 view)
+            and transport copy run on the pool thread so they OVERLAP
+            the blocking predecessor recv — the codec's encode would
+            otherwise sit on the ring's serialized critical path, which
+            a time-sliced multi-worker host punishes step after step.
+            Safe because a step's send and recv segments are disjoint by
+            schedule construction, so the thread reads acc[sb:se] (or a
+            wirearr slice) while the main thread fills a different
+            segment. Returns (done, errs) for finish_send; the encode
+            scratch is pool-returned by the thread itself (never while
+            anything can still read it)."""
+            done = threading.Event()
+            errs: List[BaseException] = []
+
+            def run() -> None:
+                try:
+                    if buf.dtype == np.uint16:
+                        payload = buf  # all-gather: already wire dtype
+                        scratch = None
+                    else:
+                        scratch = bufpool.get((se - sb) * 2)
+                        payload = np.frombuffer(scratch, np.uint16, se - sb)
+                        encode_wire(payload, buf, wire)
+                    self.client.send(
+                        send_peer, name, _buf(payload), ConnType.COLLECTIVE
+                    )
+                    if scratch is not None:
+                        bufpool.put(scratch)
+                except BaseException as e:  # noqa: BLE001 - re-raised below
+                    errs.append(e)
+                finally:
+                    done.set()
+
+            get_pool().submit(run)
+            return done, errs
+
+        def finish_send(pending, name: str) -> None:
+            done, errs = pending
+            remaining = deadline - time.monotonic()
+            if remaining <= 0 or not done.wait(remaining):
+                raise TimeoutError(f"segmented send timed out: {name}")
+            if errs:
+                raise errs[0]
+
+        def recv_rs(name: str, rb: int, re_: int) -> None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(f"segmented walk timed out: {name}")
+            recv_dtype = np.dtype(np.uint16) if wire is not None else acc.dtype
             incoming, scratch, release = self._recv_collective(
-                recv_peer, name, (re_ - rb) * itemsize, acc.dtype,
+                recv_peer, name, (re_ - rb) * wire_itemsize, recv_dtype,
                 re_ - rb, remaining,
             )
             try:
@@ -1002,10 +1368,12 @@ class HostSession:
                     # the recv buffer may already be reused — a late
                     # arrival must not be reduced into it
                     raise TimeoutError(f"collective cancelled: {name}")
-                if reducing:
-                    reduce_segment(acc, rb, re_, incoming, w.op)
+                if wire is not None:
+                    # fused decode + f32 accumulate: one pass, one
+                    # quantization deep (the sender's encode)
+                    decode_accumulate(acc, rb, re_, incoming, wire, w.op)
                 else:
-                    copy_segment(acc, rb, re_, incoming)
+                    reduce_segment(acc, rb, re_, incoming, w.op)
             finally:
                 del incoming
                 if release is not None:
@@ -1013,8 +1381,49 @@ class HostSession:
             if scratch is not None:
                 bufpool.put(scratch)
 
-        def step(phase: str, s: int, send_seg: int, recv_seg: int, reducing: bool) -> None:
-            nonlocal wire
+        def recv_ag(name: str, rb: int, re_: int) -> None:
+            remaining = deadline - time.monotonic()
+            if remaining <= 0:
+                raise TimeoutError(f"segmented walk timed out: {name}")
+            if wire is None:
+                incoming, scratch, release = self._recv_collective(
+                    recv_peer, name, (re_ - rb) * itemsize, acc.dtype,
+                    re_ - rb, remaining,
+                )
+                try:
+                    if cancel is not None and cancel.is_set():
+                        raise TimeoutError(f"collective cancelled: {name}")
+                    copy_segment(acc, rb, re_, incoming)
+                finally:
+                    del incoming
+                    if release is not None:
+                        release()
+                if scratch is not None:
+                    bufpool.put(scratch)
+                return
+            # wire mode: deliver straight into the wire buffer slice —
+            # no scratch, no decode (the segment is relayed as-is and
+            # decoded once at walk end)
+            msg, filled = self.endpoint.recv_into(
+                recv_peer, name, memoryview(wirebuf)[rb * 2 : re_ * 2],
+                remaining,
+            )
+            if cancel is not None and cancel.is_set():
+                if msg is not None and msg.release is not None:
+                    msg.release()
+                raise TimeoutError(f"collective cancelled: {name}")
+            if not filled:
+                try:
+                    np.copyto(
+                        wirearr[rb:re_],
+                        np.frombuffer(msg.data, np.uint16, re_ - rb),
+                    )
+                finally:
+                    if msg.release is not None:
+                        msg.release()
+
+        def step(phase: str, s: int, send_seg: int, recv_seg: int) -> None:
+            nonlocal wire_bytes, raw_bytes
             sb, se = bounds[send_seg]
             rb, re_ = bounds[recv_seg]
             name = f"{w.name}:{phase}{s}"
@@ -1022,36 +1431,84 @@ class HostSession:
                 raise TimeoutError(f"collective cancelled: {name}")
             # empty segments (payload < k elements) are skipped on BOTH
             # ends: sender and receiver compute identical bounds.
-            # send-then-recv is deliberately SEQUENTIAL: the send returns
-            # once the payload is in the shm ring / kernel buffer, so the
-            # wire is already busy while we block on the predecessor —
-            # and a _par pair per step measured 15% slower on the 2-core
-            # bench box (thread dispatch + GIL beat the overlap).
+            # RAW mode: send-then-recv is deliberately SEQUENTIAL — the
+            # send returns once the payload is in the shm ring / kernel
+            # buffer, so the wire is already busy while we block on the
+            # predecessor, and a _par pair per step measured 15% slower
+            # on the 2-core bench box (thread dispatch + GIL beat the
+            # overlap). WIRE mode: the encode pass makes the send phase
+            # heavy enough to flip that trade — encode+send run async on
+            # the pool thread and overlap the predecessor wait, awaited
+            # at step end (disjoint segments make this safe).
             if se > sb:
-                do_send(name, sb, se)
-                wire += (se - sb) * itemsize
+                wire_bytes += (se - sb) * wire_itemsize
+                raw_bytes += (se - sb) * itemsize
+            if wire is not None:
+                pending = None
+                if se > sb:
+                    pending = start_send_wire(
+                        name, sb, se,
+                        acc[sb:se] if phase == "rs" else wirearr[sb:se],
+                    )
+                if re_ > rb:
+                    if phase == "rs":
+                        recv_rs(name, rb, re_)
+                    else:
+                        recv_ag(name, rb, re_)
+                if pending is not None:
+                    finish_send(pending, name)
+                return
+            if se > sb:
+                do_send(name, sb, se, acc[sb:se])
             if re_ > rb:
-                do_recv(name, rb, re_, reducing)
+                if phase == "rs":
+                    recv_rs(name, rb, re_)
+                else:
+                    recv_ag(name, rb, re_)
 
         _t0 = time.perf_counter()
         for s, (snd, rcv) in enumerate(sched.rs_steps):
             with trace.span("host.rs.step", step=s, k=k):
-                step("rs", s, snd, rcv, True)
+                step("rs", s, snd, rcv)
+        if wire is not None:
+            # seed the all-gather: quantize the owned (fully reduced)
+            # segment ONCE; every peer — self included — will decode
+            # this same encoding, so results stay bit-identical ringwide
+            ob, oe = bounds[sched.owned_segment]
+            if oe > ob:
+                encode_wire(wirearr[ob:oe], acc[ob:oe], wire)
         for s, (snd, rcv) in enumerate(sched.ag_steps):
             with trace.span("host.ag.step", step=s, k=k):
-                step("ag", s, snd, rcv, False)
-        self._count_wire(wire, Strategy.RING_SEGMENTED.name)
+                step("ag", s, snd, rcv)
+        deferred: Optional[_DeferredDecode] = None
+        if wire is not None:
+            if defer_decode:
+                deferred = _DeferredDecode(wire, wirebuf, wirearr)
+            else:
+                with trace.span("host.wire.decode", bytes=int(acc.size * 2)):
+                    decode_wire(acc, wirearr, wire)
+                bufpool.put(wirebuf)
+        self._count_wire(
+            wire_bytes, Strategy.RING_SEGMENTED.name, codec_label, raw_bytes
+        )
         trace.record(
             f"host.segmented[{w.recv.nbytes >> 20}MiB]",
             time.perf_counter() - _t0,
         )
+        return deferred
 
     def _run_strategies(
         self,
         w: Workspace,
         strategies: List[st.StrategyPair],
         cancel: Optional[threading.Event] = None,
+        wire: Optional[DType] = None,
     ) -> None:
+        """`wire` is decided ONCE on the whole workspace (in
+        _allreduce_ws) and inherited by every chunk — a per-chunk
+        decision would let a residual chunk fall below WIRE_MIN_BYTES
+        and mix wire formats inside one collective (still cluster-
+        consistent, but pointlessly branchy on the hot path)."""
         total = w.recv.size * w.recv.itemsize
         k = max(1, -(-total // choose_chunk_bytes(total)))
         chunks = w.split(even_partition, k) if k > 1 else [w]
@@ -1059,14 +1516,16 @@ class HostSession:
             cancel = threading.Event()
         if k == 1:
             pair = strategies[0]
-            self._run_graphs(chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel)
+            self._run_graphs(
+                chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel, wire
+            )
             return
         jobs = []
         for i, chunk in enumerate(chunks):
             pair = st.choose(strategies, i)
             jobs.append(
                 lambda c=chunk, p=pair: self._run_graphs(
-                    c, [p.reduce_graph, p.bcast_graph], cancel
+                    c, [p.reduce_graph, p.bcast_graph], cancel, wire
                 )
             )
         _par(jobs, self.timeout, cancel)
@@ -1076,12 +1535,21 @@ class HostSession:
         w: Workspace,
         graphs: List[Graph],
         cancel: Optional[threading.Event] = None,
+        wire: Optional[DType] = None,
     ) -> None:
         """The hot walk; parity: runGraphs (session.go:231-299).
 
         `cancel` is shared across every thread touching this workspace: once
         any part of the collective times out, late-arriving receives must not
-        write into (possibly reused) caller buffers."""
+        write into (possibly reused) caller buffers.
+
+        With `wire` set, every send encodes the f32 buffer into a pooled
+        bf16/f16 scratch and every receive decode-accumulates (reduce
+        phase) or decodes (bcast phase) back into f32 — accumulation
+        never happens in 16-bit storage. Relays re-encode values that
+        are already wire-quantized, which is exact (encode of an
+        exactly-representable value is the identity), so the quantized
+        result every peer converges on is bit-identical."""
         if w.is_empty:
             return
         if all(g.is_isolated(self.rank) for g in graphs):
@@ -1100,6 +1568,7 @@ class HostSession:
             return w.send
 
         wire_label = self._walk_label()
+        codec_label = wire.name.lower() if wire is not None else "off"
 
         def send_to(peer: PeerID, flags: Flags = Flags.NONE) -> None:
             # zero-copy: the walk's phases are sequential per chunk, so the
@@ -1107,15 +1576,44 @@ class HostSession:
             self.client.send(
                 peer, w.name, _buf(effective()), ConnType.COLLECTIVE, flags
             )
-            self._count_wire(nbytes, wire_label)
+            self._count_wire(wire_nbytes, wire_label, codec_label, nbytes)
+
+        def send_all(peers: List[PeerID], flags: Flags = Flags.NONE) -> None:
+            """Fan-out send of the current effective() buffer. Wire mode
+            encodes ONCE into a shared scratch for the whole fan-out —
+            every edge carries identical bytes, so per-peer encodes (a
+            full payload pass each) would be pure waste at STAR/CLIQUE
+            fan-outs. The scratch returns to the pool only on success:
+            after a timeout an abandoned send thread may still be
+            draining it."""
+            if not peers:
+                return
+            if wire is None:
+                _par([lambda p=p: send_to(p, flags) for p in peers],
+                     self.timeout, cancel)
+                return
+            scratch = bufpool.get(wire_nbytes)
+            enc = np.frombuffer(scratch, np.uint16, w.recv.size)
+            encode_wire(enc, effective(), wire)
+
+            def send_enc(peer: PeerID) -> None:
+                self.client.send(
+                    peer, w.name, _buf(enc), ConnType.COLLECTIVE, flags
+                )
+                self._count_wire(wire_nbytes, wire_label, codec_label, nbytes)
+
+            _par([lambda p=p: send_enc(p) for p in peers], self.timeout, cancel)
+            bufpool.put(scratch)
 
         bufpool = get_buffer_pool()
         nbytes = w.recv.size * w.recv.itemsize
+        wire_nbytes = w.recv.size * 2 if wire is not None else nbytes
+        recv_dtype = np.dtype(np.uint16) if wire is not None else w.send.dtype
 
         def recv_payload(peer: PeerID):
             """See _recv_collective (shared with the segmented walk)."""
             return self._recv_collective(
-                peer, w.name, nbytes, w.send.dtype, w.recv.size, self.timeout
+                peer, w.name, wire_nbytes, recv_dtype, w.recv.size, self.timeout
             )
 
         def recv_onto(peer: PeerID) -> None:
@@ -1127,7 +1625,17 @@ class HostSession:
                         # write the workspace nor let the send phase relay
                         # stale data
                         raise TimeoutError(f"collective cancelled: {w.name}")
-                    if state["recv_count"] == 0 and not w.is_inplace:
+                    if wire is not None:
+                        if state["recv_count"] == 0 and not w.is_inplace:
+                            # first arrival: recv = decode(incoming), then
+                            # fold own send in f32 (ops are commutative)
+                            decode_wire(w.recv, incoming, wire)
+                            reduce_inplace(w.recv, w.send, w.op)
+                        else:
+                            decode_accumulate(
+                                w.recv, 0, w.recv.size, incoming, wire, w.op
+                            )
+                    elif state["recv_count"] == 0 and not w.is_inplace:
                         # first arrival: recv = send (op) incoming
                         from kungfu_tpu.base.ops import transform2
 
@@ -1170,7 +1678,17 @@ class HostSession:
                 with lock:
                     if cancel.is_set():
                         raise TimeoutError(f"collective cancelled: {w.name}")
-                    if w.is_inplace:
+                    if wire is not None:
+                        # decode-accumulate each arrival into f32 (the
+                        # fused kernel; no n-ary variant exists for mixed
+                        # wire/f32 sources and the tree fan-in is small)
+                        if not w.is_inplace:
+                            w.forward()
+                        for incoming, _, _ in got:
+                            decode_accumulate(
+                                w.recv, 0, w.recv.size, incoming, wire, w.op
+                            )
+                    elif w.is_inplace:
                         for incoming, _, _ in got:
                             reduce_inplace(w.recv, incoming, w.op)
                     else:
@@ -1194,7 +1712,10 @@ class HostSession:
                 with lock:
                     if cancel.is_set():
                         raise TimeoutError(f"collective cancelled: {w.name}")
-                    np.copyto(w.recv, incoming)
+                    if wire is not None:
+                        decode_wire(w.recv, incoming, wire)
+                    else:
+                        np.copyto(w.recv, incoming)
                     state["recv_count"] += 1
             finally:
                 del incoming
@@ -1212,7 +1733,7 @@ class HostSession:
                     recv_all_onto(prevs)
                 else:
                     _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout, cancel)
-                _par([lambda p=p: send_to(p) for p in nexts], self.timeout, cancel)
+                send_all(nexts)
             else:
                 # pass-through node: take value from single prev (or forward
                 # own), relay to nexts
@@ -1221,10 +1742,16 @@ class HostSession:
                 else:
                     for p in prevs:
                         recv_into(p)
-                _par(
-                    [lambda p=p: send_to(p, Flags.WAIT_RECV_BUF) for p in nexts],
-                    self.timeout,
-                    cancel,
-                )
+                send_all(nexts, Flags.WAIT_RECV_BUF)
+        if wire is not None and not graphs[-1].prevs(self.rank):
+            # the bcast root never receives a wire message, so it would
+            # keep its full-precision f32 result while every other peer
+            # decodes the quantized broadcast: roundtrip the root's recv
+            # through the codec so all peers land on bit-identical values
+            scratch = bufpool.get(wire_nbytes)
+            enc = np.frombuffer(scratch, np.uint16, w.recv.size)
+            encode_wire(enc, w.recv, wire)
+            decode_wire(w.recv, enc, wire)
+            bufpool.put(scratch)
         trace.record(f"host.walk[{w.recv.nbytes >> 20}MiB]",
                      time.perf_counter() - _t_walk)

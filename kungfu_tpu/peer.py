@@ -197,6 +197,11 @@ class Peer:
             self._peers = peers
             self.epoch_count += 1
         if not self.config.single_process:
+            # fail-fast BEFORE the barrier: the barrier itself walks
+            # strategy-dependent graphs, so knob-divergent peers would
+            # hang right here instead of raising a named error
+            with trace.span("worker.knob_consensus"):
+                self._session.check_knob_consensus()
             self._session.barrier(tag=f":v{self.cluster_version}")
         self._updated = True
         return True

@@ -1,5 +1,10 @@
 #!/bin/sh
 # Build the native host-side kernels into kungfu_tpu/base/.
+# reduce.cpp carries the SIMD reduce (kf_transform2/_n) AND the wire
+# codec (kf_encode_wire/kf_decode_wire/kf_decode_accumulate); a stale
+# .so missing the newer symbols degrades gracefully to the numpy paths
+# via the guarded ctypes loader (base/_native_reduce.py — asserted by
+# tests/test_wire_codec.py).
 # Usage: native/build.sh [CXX]
 set -e
 cd "$(dirname "$0")"

@@ -241,3 +241,208 @@ extern "C" int kf_transform_n(void *dst, const void *const *srcs, int32_t k,
   }
   return -1;
 }
+
+// --- wire codec (compressed host-plane collectives) --------------------
+//
+// f32 workspaces travel the wire as bf16/f16 while every reduce step
+// accumulates into an f32 buffer, so rounding stays one quantization
+// deep per transmitted value instead of compounding in 16-bit storage.
+// kf_encode_wire / kf_decode_wire are the bulk converters; the fused
+// kf_decode_accumulate does decode + reduce in one pass over the
+// segment (the per-step hot path of the segmented ring walk).
+//
+// Rounding contract: both encoders round to nearest-even, bit-matching
+// numpy's f32->f16 astype and the (bits + 0x7fff + lsb) >> 16 bf16
+// fold, so the numpy fallback in base/ops.py is a drop-in replacement
+// (asserted by the codec parity tests).
+
+namespace {
+
+// f32 -> f16 with round-to-nearest-even across normals, subnormals and
+// overflow (the existing float_to_half rounds half-up; the codec must
+// match numpy astype exactly). Subnormal rounding rides an exponent-
+// aligning float add: adding 2^-14 forces the result's ulp to the f16
+// subnormal spacing, so the hardware's RNE does the rounding for us.
+inline uint16_t f32_to_f16_rne(float ff) {
+  uint32_t f;
+  __builtin_memcpy(&f, &ff, 4);
+  const uint32_t sign = f & 0x80000000u;
+  f ^= sign;
+  uint16_t out;
+  if (f >= 0x7f800000u) {  // inf / nan
+    out = (f > 0x7f800000u) ? (uint16_t)(0x7e00u | ((f >> 13) & 0x3ffu))
+                            : (uint16_t)0x7c00u;
+  } else if (f >= ((127u + 16u) << 23)) {  // >= 2^16: overflow to inf
+    out = 0x7c00u;
+  } else if (f < (113u << 23)) {  // < 2^-14: f16 subnormal or zero
+    // align-to-ulp trick: 0.5f's f32 ulp (2^-24) IS the f16 subnormal
+    // spacing, so adding it makes the hardware's RNE round the mantissa
+    // to subnormal precision; the bits of (sum - 0.5f) are the mantissa
+    const uint32_t magic = 126u << 23;  // 0.5f
+    float tmp, magicf;
+    __builtin_memcpy(&tmp, &f, 4);
+    __builtin_memcpy(&magicf, &magic, 4);
+    tmp += magicf;
+    uint32_t t;
+    __builtin_memcpy(&t, &tmp, 4);
+    out = (uint16_t)(t - magic);
+  } else {  // normal range: rebias exponent, RNE on bit 13
+    const uint32_t mant_odd = (f >> 13) & 1u;
+    f += ((uint32_t)(15 - 127) << 23) + 0xfffu + mant_odd;
+    out = (uint16_t)(f >> 13);
+  }
+  return (uint16_t)(out | (sign >> 16));
+}
+
+template <float (*Load)(uint16_t)>
+int decode_acc(float *acc, const uint16_t *src, size_t n, int32_t op) {
+  switch (op) {
+    case SUM:
+      for (size_t i = 0; i < n; ++i) acc[i] += Load(src[i]);
+      return 0;
+    case MIN:
+      for (size_t i = 0; i < n; ++i) {
+        float b = Load(src[i]);
+        acc[i] = acc[i] < b ? acc[i] : b;
+      }
+      return 0;
+    case MAX:
+      for (size_t i = 0; i < n; ++i) {
+        float b = Load(src[i]);
+        acc[i] = acc[i] > b ? acc[i] : b;
+      }
+      return 0;
+    case PROD:
+      for (size_t i = 0; i < n; ++i) acc[i] *= Load(src[i]);
+      return 0;
+  }
+  return -1;
+}
+
+}  // namespace
+
+// F16C fast paths: the scalar f16 converters are branchy (subnormal
+// normalization loops) and defeat auto-vectorization — measured 2x
+// SLOWER end-to-end than uncompressed on the bench box, where bf16's
+// branchless integer fold vectorizes fine. vcvtps2ph/vcvtph2ps do the
+// full IEEE round-to-nearest-even conversion (subnormals, overflow) in
+// hardware, bit-matching numpy's astype — the same lever the reference
+// pulls in srcs/go/kungfu/base/f16.c. Scalar tails + non-F16C builds
+// keep the exact-RNE scalar fallbacks.
+#if defined(__F16C__)
+#include <immintrin.h>
+#endif
+
+namespace {
+
+#if defined(__F16C__)
+inline void encode_f16_bulk(uint16_t *d, const float *s, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m128i h = _mm256_cvtps_ph(_mm256_loadu_ps(s + i),
+                                _MM_FROUND_TO_NEAREST_INT | _MM_FROUND_NO_EXC);
+    _mm_storeu_si128((__m128i *)(d + i), h);
+  }
+  for (; i < n; ++i) d[i] = f32_to_f16_rne(s[i]);
+}
+
+inline void decode_f16_bulk(float *d, const uint16_t *s, size_t n) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    _mm256_storeu_ps(d + i,
+                     _mm256_cvtph_ps(_mm_loadu_si128((const __m128i *)(s + i))));
+  }
+  for (; i < n; ++i) d[i] = half_to_float(s[i]);
+}
+
+template <typename VOp, typename SOp>
+int decode_acc_f16(float *a, const uint16_t *s, size_t n, VOp vop, SOp sop) {
+  size_t i = 0;
+  for (; i + 8 <= n; i += 8) {
+    __m256 inc = _mm256_cvtph_ps(_mm_loadu_si128((const __m128i *)(s + i)));
+    _mm256_storeu_ps(a + i, vop(_mm256_loadu_ps(a + i), inc));
+  }
+  for (; i < n; ++i) a[i] = sop(a[i], half_to_float(s[i]));
+  return 0;
+}
+#endif
+
+}  // namespace
+
+extern "C" int kf_encode_wire(void *dst, const void *src, int64_t count,
+                              int32_t wire_dtype) {
+  uint16_t *d = (uint16_t *)dst;
+  const float *s = (const float *)src;
+  size_t n = (size_t)count;
+  switch (wire_dtype) {
+    case BF16:
+      for (size_t i = 0; i < n; ++i) d[i] = float_to_bf16(s[i]);
+      return 0;
+    case F16:
+#if defined(__F16C__)
+      encode_f16_bulk(d, s, n);
+#else
+      for (size_t i = 0; i < n; ++i) d[i] = f32_to_f16_rne(s[i]);
+#endif
+      return 0;
+  }
+  return -1;
+}
+
+extern "C" int kf_decode_wire(void *dst, const void *src, int64_t count,
+                              int32_t wire_dtype) {
+  float *d = (float *)dst;
+  const uint16_t *s = (const uint16_t *)src;
+  size_t n = (size_t)count;
+  switch (wire_dtype) {
+    case BF16:
+      for (size_t i = 0; i < n; ++i) d[i] = bf16_to_float(s[i]);
+      return 0;
+    case F16:
+#if defined(__F16C__)
+      decode_f16_bulk(d, s, n);
+#else
+      for (size_t i = 0; i < n; ++i) d[i] = half_to_float(s[i]);
+#endif
+      return 0;
+  }
+  return -1;
+}
+
+extern "C" int kf_decode_accumulate(void *acc, const void *src, int64_t count,
+                                    int32_t wire_dtype, int32_t op) {
+  float *a = (float *)acc;
+  const uint16_t *s = (const uint16_t *)src;
+  size_t n = (size_t)count;
+  switch (wire_dtype) {
+    case BF16: return decode_acc<bf16_to_float>(a, s, n, op);
+    case F16:
+#if defined(__F16C__)
+      // NaN caveat: _mm256_min/max_ps pick the SECOND operand on NaN,
+      // like the scalar a<b?a:b with NaN on either side picking b via
+      // the false branch — gradients are NaN-free by contract anyway
+      switch (op) {
+        case SUM:
+          return decode_acc_f16(a, s, n,
+              [](__m256 x, __m256 y) { return _mm256_add_ps(x, y); },
+              [](float x, float y) { return x + y; });
+        case MIN:
+          return decode_acc_f16(a, s, n,
+              [](__m256 x, __m256 y) { return _mm256_min_ps(x, y); },
+              [](float x, float y) { return x < y ? x : y; });
+        case MAX:
+          return decode_acc_f16(a, s, n,
+              [](__m256 x, __m256 y) { return _mm256_max_ps(x, y); },
+              [](float x, float y) { return x > y ? x : y; });
+        case PROD:
+          return decode_acc_f16(a, s, n,
+              [](__m256 x, __m256 y) { return _mm256_mul_ps(x, y); },
+              [](float x, float y) { return x * y; });
+      }
+      return -1;
+#else
+      return decode_acc<half_to_float>(a, s, n, op);
+#endif
+  }
+  return -1;
+}

@@ -19,6 +19,11 @@ def main() -> None:
     algo = os.environ.get("KF_BENCH_ALGO", "")
     if algo:
         argv += ["--algo", algo]
+    wire = os.environ.get("KF_BENCH_WIRE", "")
+    if wire:
+        argv += ["--wire", wire]
+    if os.environ.get("KF_BENCH_WIRE_AB", ""):
+        argv += ["--wire-ab"]
     sys.argv = argv
     from kungfu_tpu.benchmarks.__main__ import main as bench_main
 

@@ -13,18 +13,25 @@ REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 AGENT = os.path.join(REPO, "tests", "integration", "bench_host_agent.py")
 
 
-@pytest.mark.parametrize("algo", ["tree", "segmented"])
-def test_bench_host_ab_smoke(algo):
+@pytest.mark.parametrize("algo,wire", [
+    ("tree", ""),
+    ("segmented", ""),
+    ("segmented", "bf16"),
+])
+def test_bench_host_ab_smoke(algo, wire):
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
     env["JAX_PLATFORMS"] = "cpu"
-    # tiny payloads sit below the segmentation threshold; drop it so the
-    # segmented leg actually walks rs/ag steps (cluster-agreed via the
-    # runner env)
+    # tiny payloads sit below the segmentation + codec thresholds; drop
+    # them so the segmented/compressed legs actually exercise their
+    # paths (cluster-agreed via the runner env)
     env["KF_CONFIG_SEGMENT_MIN_BYTES"] = "0"
+    env["KF_CONFIG_WIRE_MIN_BYTES"] = "0"
     env["KF_BENCH_ALGO"] = algo
     env["KF_BENCH_MODEL"] = "tiny"
     env["KF_BENCH_ITERS"] = "2"
+    if wire:
+        env["KF_BENCH_WIRE"] = wire
     r = subprocess.run(
         [
             sys.executable, "-m", "kungfu_tpu.runner.cli",
@@ -36,9 +43,15 @@ def test_bench_host_ab_smoke(algo):
     assert r.returncode == 0, f"stdout:\n{r.stdout}\nstderr:\n{r.stderr}"
     assert "RESULT:" in r.stdout, r.stdout
     # the A/B must report per-peer wire bytes, labelled with the forced
-    # strategy family
+    # strategy family and the codec dimension
     want_label = "RING_SEGMENTED" if algo == "segmented" else "BINARY_TREE"
+    want_codec = f'codec="{wire or "off"}"'
     # worker stdout arrives prefixed with the runner's [rank/np] tag
     wire_lines = [l for l in r.stdout.splitlines() if "WIRE " in l]
     assert wire_lines, r.stdout
-    assert any(want_label in l for l in wire_lines), r.stdout
+    assert any(want_label in l and want_codec in l for l in wire_lines), (
+        r.stdout
+    )
+    if wire:
+        # compressed leg must also report the bytes the codec saved
+        assert any("saved by codec" in l for l in wire_lines), r.stdout

@@ -312,9 +312,9 @@ def test_segmented_wire_bytes_optimal(clusters, monkeypatch):
         ctr = tmetrics.counter(
             "kungfu_collective_wire_bytes_total",
             "Host-plane collective payload bytes sent by this peer",
-            ("collective", "strategy"),
+            ("collective", "strategy", "codec"),
         )
-        child = ctr.labels("all_reduce", "RING_SEGMENTED")
+        child = ctr.labels("all_reduce", "RING_SEGMENTED", "off")
         before = child.value
         n = 40_000  # elements, f32
         xs = [np.full(n, float(r + 1), np.float32) for r in range(np_)]
