@@ -147,6 +147,8 @@ def bench_host(model: str, iters: int, warmup: int = 4) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
 
+    from kungfu_tpu.collective.host_session import get_walk_profiler
+
     grads = fake_gradients(model)
     outs = [np.empty_like(g) for g in grads]
     total_bytes = sum(g.nbytes for g in grads)
@@ -161,6 +163,9 @@ def bench_host(model: str, iters: int, warmup: int = 4) -> None:
         api.group_all_reduce_arrays(grads, name=f"warmup:{i}", outs=outs)
     wire_before = _wire_samples()
     saved_before = _wire_saved()
+    # the EFF report below must describe the measured iterations only:
+    # warmup walks run on cold pools and would drag the attribution
+    get_walk_profiler().reset()
     samples = []
     for i in range(iters):
         t0 = time.perf_counter()
@@ -193,6 +198,20 @@ def bench_host(model: str, iters: int, warmup: int = 4) -> None:
             log.echo(
                 f"WIRE saved by codec: {saved / iters / (1 << 20):.1f} "
                 f"MiB/iter ({saved / iters / total_bytes:.2f}x payload)"
+            )
+        # utilization, not just bytes (ISSUE 6): per walk family the
+        # achieved throughput at the 2(k-1)/k*N bandwidth-optimal byte
+        # volume, the efficiency ratio against the measured link speed
+        # when the link plane has an estimate, and where the walk time
+        # went (wait-on-recv / reduce+codec compute / send-blocked)
+        for key, s in sorted(get_walk_profiler().snapshot().items()):
+            eff = s.get("efficiency")
+            eff_s = f", {eff:.2f} of link bw" if eff is not None else ""
+            log.echo(
+                f"EFF {key}: {s['achieved_gib_s']:.3f} GiB/s at the "
+                f"2(k-1)/k bound{eff_s} "
+                f"(wait {s['wait_frac']:.0%} compute {s['compute_frac']:.0%} "
+                f"send {s['send_frac']:.0%}, {s['walks']} walks)"
             )
         # where the time went (hot-path spans, this process only)
         summary = api.trace_summary()

@@ -35,6 +35,7 @@ from kungfu_tpu.base.ops import (
     transform_n,
 )
 from kungfu_tpu.telemetry import config as tconfig
+from kungfu_tpu.telemetry import link as tlink
 from kungfu_tpu.telemetry import metrics as tmetrics
 from kungfu_tpu.utils import trace
 from kungfu_tpu.base.strategy import Strategy
@@ -215,6 +216,241 @@ class _DeferredDecode:
             self._buf = None
 
 
+class _WalkProfile:
+    """Per-walk critical-path accumulator (one walk = one thread running
+    one segmented ring or one chunk's graph pair): seconds the walk
+    thread spent blocked on receives and blocked on sends. Everything
+    else — reduce/codec kernels, pack/unpack memcpys, Python overhead —
+    is compute by construction (wall − wait − send), so the three
+    fractions always sum to 1."""
+
+    __slots__ = ("wait", "send")
+
+    def __init__(self):
+        self.wait = 0.0
+        self.send = 0.0
+
+
+class _SpanSampler:
+    """Deterministic walk sampler for per-step spans
+    (KF_TELEMETRY_SPAN_SAMPLE): emits per-step spans for walk n iff the
+    integer part of n*rate advances — exactly rate*N of any N walks,
+    evenly spaced, identical across reruns (no RNG)."""
+
+    __slots__ = ("rate", "_n", "_lock")
+
+    def __init__(self, rate: float):
+        self.rate = rate
+        self._n = 0
+        self._lock = threading.Lock()
+
+    def sample(self) -> bool:
+        if self.rate >= 1.0:
+            return True
+        if self.rate <= 0.0:
+            return False
+        with self._lock:
+            self._n += 1
+            n = self._n
+        return int(n * self.rate) != int((n - 1) * self.rate)
+
+
+class WalkProfiler:
+    """Collective critical-path profiler (ISSUE 6 tentpole, part b).
+
+    Aggregates every allreduce walk's wall-time attribution per
+    (public collective, executing strategy): fractions of walk time
+    spent wait-on-recv vs reduce/codec compute vs send-blocked, the
+    achieved throughput against the 2·(k−1)/k·N bandwidth-optimal
+    bound, and — when the link plane has a bandwidth estimate for the
+    links the walk used — an **efficiency ratio**:
+
+        efficiency = (2·(k−1)/k·N / link_bw) / wall
+                   = optimal transfer time / achieved wall time
+
+    1.0 means the walk moved its optimal byte volume at full measured
+    link speed; the gap to 1.0 is the overhead the async scheduler and
+    topology re-planner (ROADMAP items 2/5) have to harvest. Exported
+    as ``kungfu_collective_efficiency_ratio`` gauges and
+    ``kungfu_collective_walk_seconds_total{phase}`` counters; process-
+    global (sessions are rebuilt every elastic epoch, the attribution
+    series must survive them).
+
+    Attribution caveats (documented, not bugs): on graph walks the
+    pairwise receive path folds its in-place reduce into the timed
+    receive block (the n-ary fan-in path separates them), and wire-mode
+    fan-out encodes land in compute while the transport part of the
+    fan-out lands in send. The fractions describe the walk *thread*;
+    pool-thread work overlapped with a timed block is deliberately not
+    double-counted.
+    """
+
+    _ALPHA = 0.2  # EWMA for the efficiency series, matches the link plane
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._acc: Dict[Tuple[str, str], dict] = {}
+
+    def record(
+        self,
+        collective: str,
+        strategy: str,
+        k: int,
+        payload_bytes: int,
+        wall: float,
+        wait: float,
+        send: float,
+        link_bw: Optional[float] = None,
+    ) -> None:
+        if wall <= 0.0 or k < 2 or payload_bytes <= 0:
+            return
+        # clamp measurement jitter so per-walk phases never exceed wall
+        # (fractions must sum to 1 by construction)
+        blocked = wait + send
+        if blocked > wall:
+            scale = wall / blocked
+            wait *= scale
+            send *= scale
+        opt_bytes = 2.0 * (k - 1) / k * payload_bytes
+        eff = None
+        if link_bw is not None and link_bw > 0:
+            eff = (opt_bytes / link_bw) / wall
+        key = (collective, strategy)
+        with self._lock:
+            a = self._acc.get(key)
+            if a is None:
+                a = self._acc[key] = {
+                    "walks": 0, "wall": 0.0, "wait": 0.0, "send": 0.0,
+                    "payload_bytes": 0.0, "opt_bytes": 0.0,
+                    "eff": None, "eff_samples": 0,
+                    # EWMAs of RECENT walks, for signals(): the cumulative
+                    # sums above describe the whole run (snapshot/bench),
+                    # but an adaptation signal weighted by all-time sums
+                    # goes inert after hours — a link that degrades at
+                    # walk 50,000 must move the signal within ~10 walks,
+                    # like the link plane's own bandwidth EWMA does
+                    "wait_frac_ewma": None, "wall_ewma": None,
+                }
+            a["walks"] += 1
+            a["wall"] += wall
+            a["wait"] += wait
+            a["send"] += send
+            a["payload_bytes"] += payload_bytes
+            a["opt_bytes"] += opt_bytes
+            wf = wait / wall
+            a["wait_frac_ewma"] = (
+                wf if a["wait_frac_ewma"] is None
+                else self._ALPHA * wf + (1.0 - self._ALPHA) * a["wait_frac_ewma"]
+            )
+            a["wall_ewma"] = (
+                wall if a["wall_ewma"] is None
+                else self._ALPHA * wall + (1.0 - self._ALPHA) * a["wall_ewma"]
+            )
+            if eff is not None:
+                a["eff"] = (
+                    eff if a["eff"] is None
+                    else self._ALPHA * eff + (1.0 - self._ALPHA) * a["eff"]
+                )
+                a["eff_samples"] += 1
+                ewma = a["eff"]
+            else:
+                ewma = None
+        self._publish(collective, strategy, wall, wait, send, ewma)
+
+    def _publish(self, collective, strategy, wall, wait, send, eff) -> None:
+        # re-read the gate every walk (once per walk, not per step):
+        # the profiler is process-global and outlives session epochs,
+        # so a one-shot cache would freeze a pre-enable() answer forever
+        if not tconfig.metrics_enabled():
+            return
+        phases = tmetrics.counter(
+            "kungfu_collective_walk_seconds_total",
+            "Walk wall time attributed to wait-on-recv / reduce+codec "
+            "compute / send-blocked, per collective and strategy",
+            ("collective", "strategy", "phase"),
+        )
+        phases.labels(collective, strategy, "wait").inc(wait)
+        phases.labels(collective, strategy, "send").inc(send)
+        phases.labels(collective, strategy, "compute").inc(
+            max(wall - wait - send, 0.0)
+        )
+        if eff is not None:
+            tmetrics.gauge(
+                "kungfu_collective_efficiency_ratio",
+                "EWMA of achieved walk time vs the 2(k-1)/k*N bandwidth-"
+                "optimal bound at measured link speed (1.0 = optimal)",
+                ("collective", "strategy"),
+            ).labels(collective, strategy).set(eff)
+
+    def snapshot(self) -> Dict[str, dict]:
+        """Per-'collective/strategy' attribution summary; fractions sum
+        to ~1.0 (compute is the residual)."""
+        with self._lock:
+            items = {k: dict(v) for k, v in self._acc.items()}
+        out: Dict[str, dict] = {}
+        for (collective, strategy), a in sorted(items.items()):
+            wall = a["wall"]
+            if wall <= 0:
+                continue
+            wait_f = a["wait"] / wall
+            send_f = a["send"] / wall
+            out[f"{collective}/{strategy}"] = {
+                "walks": a["walks"],
+                "wall_s": wall,
+                "payload_bytes": a["payload_bytes"],
+                "wait_frac": wait_f,
+                "send_frac": send_f,
+                "compute_frac": max(1.0 - wait_f - send_f, 0.0),
+                "achieved_gib_s": a["opt_bytes"] / wall / (1 << 30),
+                "efficiency": a["eff"],
+                "efficiency_samples": a["eff_samples"],
+            }
+        return out
+
+    def signals(self) -> Dict[str, float]:
+        """Adaptation-facing summary for PolicyContext.metrics: the
+        EWMA wait fraction and efficiency of RECENT walks, weighted
+        across walk families by each family's recent wall time (a family
+        that stopped running stops steering the signal; one that turned
+        slow dominates it — all-time sums would go inert on long runs)."""
+        with self._lock:
+            # copy under the lock (like snapshot): the per-key dicts are
+            # mutated by record() on walk threads, and the sums below
+            # must read one consistent state
+            items = [dict(v) for v in self._acc.values()]
+        items = [a for a in items if a["wall_ewma"]]
+        wall = sum(a["wall_ewma"] for a in items)
+        if wall <= 0:
+            return {}
+        out: Dict[str, float] = {
+            "collective/wait_frac": (
+                sum(a["wall_ewma"] * a["wait_frac_ewma"] for a in items) / wall
+            ),
+        }
+        eff_wall = sum(a["wall_ewma"] for a in items if a["eff"] is not None)
+        if eff_wall > 0:
+            out["collective/efficiency"] = (
+                sum(
+                    a["wall_ewma"] * a["eff"]
+                    for a in items
+                    if a["eff"] is not None
+                )
+                / eff_wall
+            )
+        return out
+
+    def reset(self) -> None:
+        with self._lock:
+            self._acc.clear()
+
+
+_walk_profiler = WalkProfiler()
+
+
+def get_walk_profiler() -> WalkProfiler:
+    return _walk_profiler
+
+
 class _CollectiveScope:
     """Span + latency-histogram wrapper around one public collective
     (plain classes end to end — tracing._Span underneath is also
@@ -361,6 +597,11 @@ class HostSession:
         # audit dedup for codec bypasses: one event per (reason, dtype)
         # per session epoch, so consensus lanes don't flood the audit log
         self._codec_bypass_seen: set = set()
+        # link plane + walk profiler (ISSUE 6): the local link table
+        # supplies per-destination bandwidth estimates the profiler
+        # scores walks against; the sampler thins per-step spans
+        self._links = tlink.get_table() if tlink.enabled() else None
+        self._span_sampler = _SpanSampler(tconfig.span_sample())
 
     def _candidate(self, idx: int) -> List[st.StrategyPair]:
         if idx not in self._candidates_built:
@@ -396,6 +637,26 @@ class HostSession:
             self._wire_saved_ctr.labels(self._wire_kind, codec).inc(
                 raw_bytes - nbytes
             )
+
+    def _record_walk(
+        self,
+        strategy_label: str,
+        k: int,
+        payload_bytes: int,
+        wall: float,
+        prof: "_WalkProfile",
+        dsts=None,
+    ) -> None:
+        """Feed one finished allreduce walk to the process profiler,
+        scored against the slowest link the walk used (all estimated
+        links when `dsts` is None — graph walks fan out over many)."""
+        link_bw = None
+        if self._links is not None:
+            _, link_bw = self._links.min_bandwidth(dsts)
+        _walk_profiler.record(
+            self._wire_kind, strategy_label, k, payload_bytes,
+            wall, prof.wait, prof.send, link_bw,
+        )
 
     def _walk_label(self) -> str:
         """Strategy label for graph-walk wire accounting. Labels the
@@ -1264,6 +1525,11 @@ class HostSession:
         deadline = time.monotonic() + self.timeout
         wire_bytes = 0
         raw_bytes = 0
+        # critical-path attribution for this walk (profiler, ISSUE 6):
+        # wait-on-recv and send-blocked seconds of THIS thread; the
+        # reduce/codec compute is the residual against walk wall time
+        prof = _WalkProfile()
+        emit_steps = self._span_sampler.sample()
         # all-gather wire buffer: segments stay encoded here from the
         # owner's single quantization until the walk-end decode. Leaked
         # (not pool-returned) on any error — the transport may still be
@@ -1302,8 +1568,11 @@ class HostSession:
                 finally:
                     done.set()
 
+            _t_send = time.perf_counter()
             get_pool().submit(run)
-            if not done.wait(remaining):
+            ok = done.wait(remaining)
+            prof.send += time.perf_counter() - _t_send
+            if not ok:
                 raise TimeoutError(f"segmented send timed out: {name}")
             if errs:
                 raise errs[0]
@@ -1348,7 +1617,10 @@ class HostSession:
         def finish_send(pending, name: str) -> None:
             done, errs = pending
             remaining = deadline - time.monotonic()
-            if remaining <= 0 or not done.wait(remaining):
+            _t_send = time.perf_counter()
+            ok = remaining > 0 and done.wait(remaining)
+            prof.send += time.perf_counter() - _t_send
+            if not ok:
                 raise TimeoutError(f"segmented send timed out: {name}")
             if errs:
                 raise errs[0]
@@ -1358,10 +1630,12 @@ class HostSession:
             if remaining <= 0:
                 raise TimeoutError(f"segmented walk timed out: {name}")
             recv_dtype = np.dtype(np.uint16) if wire is not None else acc.dtype
+            _t_recv = time.perf_counter()
             incoming, scratch, release = self._recv_collective(
                 recv_peer, name, (re_ - rb) * wire_itemsize, recv_dtype,
                 re_ - rb, remaining,
             )
+            prof.wait += time.perf_counter() - _t_recv
             try:
                 if cancel is not None and cancel.is_set():
                     # caller-scope timeout fired while we were blocked:
@@ -1386,10 +1660,12 @@ class HostSession:
             if remaining <= 0:
                 raise TimeoutError(f"segmented walk timed out: {name}")
             if wire is None:
+                _t_recv = time.perf_counter()
                 incoming, scratch, release = self._recv_collective(
                     recv_peer, name, (re_ - rb) * itemsize, acc.dtype,
                     re_ - rb, remaining,
                 )
+                prof.wait += time.perf_counter() - _t_recv
                 try:
                     if cancel is not None and cancel.is_set():
                         raise TimeoutError(f"collective cancelled: {name}")
@@ -1404,10 +1680,12 @@ class HostSession:
             # wire mode: deliver straight into the wire buffer slice —
             # no scratch, no decode (the segment is relayed as-is and
             # decoded once at walk end)
+            _t_recv = time.perf_counter()
             msg, filled = self.endpoint.recv_into(
                 recv_peer, name, memoryview(wirebuf)[rb * 2 : re_ * 2],
                 remaining,
             )
+            prof.wait += time.perf_counter() - _t_recv
             if cancel is not None and cancel.is_set():
                 if msg is not None and msg.release is not None:
                     msg.release()
@@ -1466,10 +1744,22 @@ class HostSession:
                 else:
                     recv_ag(name, rb, re_)
 
+        def timed_step(span_name: str, phase: str, s: int, snd: int, rcv: int) -> None:
+            """One ring step, with a per-step span (subject to
+            KF_TELEMETRY_SPAN_SAMPLE) annotated with how long the step
+            was blocked waiting on its predecessor vs its successor."""
+            if not emit_steps:
+                step(phase, s, snd, rcv)
+                return
+            w0, s0 = prof.wait, prof.send
+            with trace.span(span_name, step=s, k=k) as sp:
+                step(phase, s, snd, rcv)
+                sp.args["wait_us"] = round((prof.wait - w0) * 1e6)
+                sp.args["send_us"] = round((prof.send - s0) * 1e6)
+
         _t0 = time.perf_counter()
         for s, (snd, rcv) in enumerate(sched.rs_steps):
-            with trace.span("host.rs.step", step=s, k=k):
-                step("rs", s, snd, rcv)
+            timed_step("host.rs.step", "rs", s, snd, rcv)
         if wire is not None:
             # seed the all-gather: quantize the owned (fully reduced)
             # segment ONCE; every peer — self included — will decode
@@ -1478,8 +1768,7 @@ class HostSession:
             if oe > ob:
                 encode_wire(wirearr[ob:oe], acc[ob:oe], wire)
         for s, (snd, rcv) in enumerate(sched.ag_steps):
-            with trace.span("host.ag.step", step=s, k=k):
-                step("ag", s, snd, rcv)
+            timed_step("host.ag.step", "ag", s, snd, rcv)
         deferred: Optional[_DeferredDecode] = None
         if wire is not None:
             if defer_decode:
@@ -1491,9 +1780,13 @@ class HostSession:
         self._count_wire(
             wire_bytes, Strategy.RING_SEGMENTED.name, codec_label, raw_bytes
         )
-        trace.record(
-            f"host.segmented[{w.recv.nbytes >> 20}MiB]",
-            time.perf_counter() - _t0,
+        wall = time.perf_counter() - _t0
+        trace.record(f"host.segmented[{w.recv.nbytes >> 20}MiB]", wall)
+        # the ring's only outgoing edge is the successor: score this walk
+        # against that link's measured bandwidth
+        self._record_walk(
+            Strategy.RING_SEGMENTED.name, k, w.recv.nbytes, wall, prof,
+            dsts=[send_peer],
         )
         return deferred
 
@@ -1517,7 +1810,8 @@ class HostSession:
         if k == 1:
             pair = strategies[0]
             self._run_graphs(
-                chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel, wire
+                chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel,
+                wire, profile=True,
             )
             return
         jobs = []
@@ -1525,7 +1819,8 @@ class HostSession:
             pair = st.choose(strategies, i)
             jobs.append(
                 lambda c=chunk, p=pair: self._run_graphs(
-                    c, [p.reduce_graph, p.bcast_graph], cancel, wire
+                    c, [p.reduce_graph, p.bcast_graph], cancel, wire,
+                    profile=True,
                 )
             )
         _par(jobs, self.timeout, cancel)
@@ -1536,8 +1831,14 @@ class HostSession:
         graphs: List[Graph],
         cancel: Optional[threading.Event] = None,
         wire: Optional[DType] = None,
+        profile: bool = False,
     ) -> None:
         """The hot walk; parity: runGraphs (session.go:231-299).
+
+        `profile=True` (the allreduce paths, via _run_strategies) feeds
+        this walk's wait/send/compute attribution to the process
+        WalkProfiler; direct reduce/broadcast/gather walks skip it (the
+        2(k-1)/k*N allreduce bound doesn't describe them).
 
         `cancel` is shared across every thread touching this workspace: once
         any part of the collective times out, late-arriving receives must not
@@ -1558,6 +1859,7 @@ class HostSession:
         if cancel is None:
             cancel = threading.Event()
         _t_walk = time.perf_counter()
+        prof = _WalkProfile() if profile else None
 
         state = {"recv_count": 0}
         lock = threading.Lock()
@@ -1589,11 +1891,16 @@ class HostSession:
             if not peers:
                 return
             if wire is None:
+                _t_send = time.perf_counter()
                 _par([lambda p=p: send_to(p, flags) for p in peers],
                      self.timeout, cancel)
+                if prof is not None:
+                    prof.send += time.perf_counter() - _t_send
                 return
             scratch = bufpool.get(wire_nbytes)
             enc = np.frombuffer(scratch, np.uint16, w.recv.size)
+            # the fan-out encode is codec COMPUTE (the residual bucket),
+            # so only the transport fan-out below is timed as send
             encode_wire(enc, effective(), wire)
 
             def send_enc(peer: PeerID) -> None:
@@ -1602,7 +1909,10 @@ class HostSession:
                 )
                 self._count_wire(wire_nbytes, wire_label, codec_label, nbytes)
 
+            _t_send = time.perf_counter()
             _par([lambda p=p: send_enc(p) for p in peers], self.timeout, cancel)
+            if prof is not None:
+                prof.send += time.perf_counter() - _t_send
             bufpool.put(scratch)
 
         bufpool = get_buffer_pool()
@@ -1670,11 +1980,14 @@ class HostSession:
                 got[i] = res
 
             try:
+                _t_recv = time.perf_counter()
                 _par(
                     [lambda i=i, p=p: grab(i, p) for i, p in enumerate(peers)],
                     self.timeout,
                     cancel,
                 )
+                if prof is not None:
+                    prof.wait += time.perf_counter() - _t_recv
                 with lock:
                     if cancel.is_set():
                         raise TimeoutError(f"collective cancelled: {w.name}")
@@ -1731,8 +2044,14 @@ class HostSession:
                 # accumulate: receive from all prevs, n-ary reduce, send on
                 if prevs and state["recv_count"] == 0:
                     recv_all_onto(prevs)
-                else:
+                elif prevs:
+                    # pairwise path: the pool threads fold their reduce
+                    # into this timed block (profiler caveat, see
+                    # WalkProfiler) — receives dominate it
+                    _t_recv = time.perf_counter()
                     _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout, cancel)
+                    if prof is not None:
+                        prof.wait += time.perf_counter() - _t_recv
                 send_all(nexts)
             else:
                 # pass-through node: take value from single prev (or forward
@@ -1740,8 +2059,11 @@ class HostSession:
                 if not prevs and state["recv_count"] == 0:
                     w.forward()
                 else:
+                    _t_recv = time.perf_counter()
                     for p in prevs:
                         recv_into(p)
+                    if prof is not None:
+                        prof.wait += time.perf_counter() - _t_recv
                 send_all(nexts, Flags.WAIT_RECV_BUF)
         if wire is not None and not graphs[-1].prevs(self.rank):
             # the bcast root never receives a wire message, so it would
@@ -1753,5 +2075,9 @@ class HostSession:
             encode_wire(enc, w.recv, wire)
             decode_wire(w.recv, enc, wire)
             bufpool.put(scratch)
-        trace.record(f"host.walk[{w.recv.nbytes >> 20}MiB]",
-                     time.perf_counter() - _t_walk)
+        wall = time.perf_counter() - _t_walk
+        trace.record(f"host.walk[{w.recv.nbytes >> 20}MiB]", wall)
+        if prof is not None:
+            # graph walks fan out over many edges: score against the
+            # slowest estimated link overall (dsts=None)
+            self._record_walk(wire_label, self.size, w.recv.nbytes, wall, prof)

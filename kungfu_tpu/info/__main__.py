@@ -1,5 +1,6 @@
 """Usage: python3 -m kungfu_tpu.info [--no-devices] [--telemetry [URL]]
        python3 -m kungfu_tpu.info top [--watch] [--interval S] [URL]
+       python3 -m kungfu_tpu.info links [--watch] [--interval S] [URL]
        python3 -m kungfu_tpu.info postmortem [DIR|URL]
 
 Prints framework, backend and cluster-env diagnostics (parity:
@@ -17,6 +18,13 @@ KF_CLUSTER_HEALTH_URL — exported to every worker by kfrun -w
 -debug-port N) and renders one row per peer: step rate, step-time
 p50/p99, bytes tx/rx, scrape age, straggler flag. --watch refreshes in
 place until interrupted.
+
+`links` renders the cluster's k×k link matrix (ISSUE 6): per directed
+edge the passively-measured EWMA bandwidth (MiB/s) from the runner's
+/cluster/links endpoint, slow edges (< half the median) highlighted
+with `!`. Point it at the runner debug endpoint (or it derives the URL
+from KF_CLUSTER_HEALTH_URL). This is the "which link is slow?" view —
+see the runbook in docs/telemetry.md.
 
 `postmortem` reconstructs the death timeline of crashed workers
 (ISSUE 3): point it at a telemetry run dir (KF_TELEMETRY_DIR, default
@@ -211,6 +219,111 @@ def _cmd_top(argv) -> int:
             return 0
 
 
+def render_links(doc: dict) -> str:
+    """One frame of `info links`: the k×k bandwidth matrix over
+    /cluster/links. Rows are source peers (numbered, legend below),
+    columns destinations; cells are EWMA bandwidth in MiB/s. Edges
+    slower than half the median carry a `!` marker — the "which link is
+    slow?" answer at a glance."""
+    peers = doc.get("peers", [])
+    edges = doc.get("edges", {})
+    if not peers:
+        return "no peers in the link matrix yet (no scrape, or telemetry off)"
+    idx = {p: i for i, p in enumerate(peers)}
+    bws = [
+        info.get("bw")
+        for row in edges.values()
+        for info in row.values()
+        if isinstance(info.get("bw"), (int, float)) and info.get("bw") > 0
+    ]
+    median = sorted(bws)[len(bws) // 2] if bws else None
+    slow_cut = median / 2 if median else None
+
+    def cell(src: str, dst: str) -> str:
+        if src == dst:
+            return "."
+        bw = edges.get(src, {}).get(dst, {}).get("bw")
+        if not isinstance(bw, (int, float)) or bw <= 0:
+            return "-"
+        mark = "!" if slow_cut is not None and bw < slow_cut else ""
+        return f"{bw / (1 << 20):.1f}{mark}"
+
+    cols = ["SRC\\DST"] + [f"[{idx[p]}]" for p in peers]
+    rows = [cols]
+    for src in peers:
+        rows.append([f"[{idx[src]}]"] + [cell(src, dst) for dst in peers])
+    widths = [max(len(r[i]) for r in rows) for i in range(len(cols))]
+    lines = ["  ".join(c.rjust(w) for c, w in zip(r, widths)) for r in rows]
+    min_bw = doc.get("min_bw")
+    slowest = doc.get("slowest_edge")
+    summary = f"{len(peers)} peers, bandwidth MiB/s (EWMA, passive)"
+    if isinstance(min_bw, (int, float)) and slowest:
+        summary += (
+            f"; slowest edge [{idx.get(slowest[0], '?')}]→"
+            f"[{idx.get(slowest[1], '?')}] at {min_bw / (1 << 20):.1f} MiB/s"
+        )
+    legend = [f"  [{i}] {p}" for p, i in sorted(idx.items(), key=lambda kv: kv[1])]
+    notes = "cells: MiB/s, '-' no estimate yet, '!' under half the median"
+    return "\n".join([summary] + lines + [notes, "peers:"] + legend)
+
+
+def _links_url(argv) -> str:
+    """Resolve the /cluster/links URL: explicit argument (full path or
+    debug-endpoint base), else derived from KF_CLUSTER_HEALTH_URL."""
+    urls = [a for a in argv if a.startswith("http")]
+    url = urls[0] if urls else os.environ.get("KF_CLUSTER_HEALTH_URL", "")
+    if not url:
+        return ""
+    url = url.rstrip("/")
+    if url.endswith("/cluster/health"):
+        url = url[: -len("/cluster/health")]
+    if not url.endswith("/cluster/links"):
+        url += "/cluster/links"
+    return url
+
+
+def _cmd_links(argv) -> int:
+    watch = "--watch" in argv
+    interval = 2.0
+    if "--interval" in argv:
+        idx = argv.index("--interval")
+        try:
+            interval = float(argv[idx + 1])
+        except (IndexError, ValueError):
+            print("info links: --interval wants seconds, e.g. --interval 2",
+                  file=sys.stderr)
+            return 2
+    url = _links_url(argv)
+    if not url:
+        print(
+            "info links: no /cluster/links URL — pass one (or a runner "
+            "debug endpoint), or run under kfrun -w -debug-port N "
+            "(which exports KF_CLUSTER_HEALTH_URL)",
+            file=sys.stderr,
+        )
+        return 2
+    while True:
+        try:
+            try:
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    doc = json.loads(r.read().decode())
+                frame = render_links(doc)
+            except (OSError, ValueError) as e:
+                if not watch:
+                    print(f"info links: fetch {url} failed: {e}",
+                          file=sys.stderr)
+                    return 1
+                frame = f"info links: fetch failed, retrying: {e}"
+            if watch:
+                print("\x1b[H\x1b[2J" + frame, flush=True)
+                time.sleep(interval)
+            else:
+                print(frame)
+                return 0
+        except KeyboardInterrupt:
+            return 0
+
+
 def _cmd_postmortem(argv) -> int:
     from kungfu_tpu.telemetry import flight
 
@@ -256,6 +369,8 @@ def _cmd_postmortem(argv) -> int:
 def main(argv) -> None:
     if argv and argv[0] == "top":
         sys.exit(_cmd_top(argv[1:]))
+    if argv and argv[0] == "links":
+        sys.exit(_cmd_links(argv[1:]))
     if argv and argv[0] == "postmortem":
         sys.exit(_cmd_postmortem(argv[1:]))
     _show_versions()

@@ -196,6 +196,16 @@ class Peer:
             )
             self._peers = peers
             self.epoch_count += 1
+            # link plane: drop estimators for departed destinations —
+            # a shed peer's frozen bandwidth estimate must not keep
+            # winning links/min_bw or walk-efficiency scoring (runners
+            # stay: stable control-plane membership)
+            from kungfu_tpu.telemetry import link as tlink
+
+            if tlink.enabled():
+                tlink.get_table().prune(
+                    list(peers) + list(self.config.runners)
+                )
         if not self.config.single_process:
             # fail-fast BEFORE the barrier: the barrier itself walks
             # strategy-dependent graphs, so knob-divergent peers would

@@ -15,6 +15,13 @@ pulls the aggregator's cluster-health signals back into
 ``cluster/step_skew``, ``cluster/self_straggler``, ...) so a
 ``BasePolicy`` can trigger a resize or strategy switch on cross-peer
 skew. See :class:`StragglerPolicy` for the canonical consumer.
+
+The link plane (ISSUE 6) adds ``links/min_bw`` + ``links/slowest_edge``
+(cluster-wide when the runner aggregator is live, else this worker's
+own outgoing row) and ``collective/efficiency`` +
+``collective/wait_frac`` from the walk profiler — the measured inputs
+for straggler-adaptive topology re-planning and the async collective
+scheduler (ROADMAP items 2/5).
 """
 
 from __future__ import annotations
@@ -93,12 +100,35 @@ class PolicyRunner:
             )
 
     def _pull_cluster_signals(self) -> None:
-        """Merge the aggregator's cluster-health signals into
-        ctx.metrics (throttled; absent plane = no-op)."""
+        """Merge the link-plane/profiler signals and the aggregator's
+        cluster-health signals into ctx.metrics (throttled; absent
+        plane = no-op). Worker-local signals land first so the
+        cluster-wide view — when a runner aggregator is live — wins on
+        the shared ``links/*`` keys."""
         now = time.monotonic()
         if now - self._signals_at < self.CLUSTER_SIGNAL_PERIOD:
             return
         self._signals_at = now
+        try:
+            # this worker's own view: its outgoing-link row
+            # (links/min_bw, links/slowest_edge) and the collective
+            # critical-path profile (collective/efficiency, wait_frac).
+            # Evict the previous refresh's values FIRST: a source that
+            # went quiet (e.g. the only estimated peer departed and was
+            # pruned) returns {} and must take its stale signals with it
+            # — a frozen links/min_bw steering re-planning hours later
+            # is the exact staleness LinkTable.prune exists to prevent
+            from kungfu_tpu.collective.host_session import get_walk_profiler
+            from kungfu_tpu.telemetry import link as _link
+
+            for key in ("links/min_bw", "links/slowest_edge",
+                        "collective/efficiency", "collective/wait_frac"):
+                self.ctx.metrics.pop(key, None)
+            if _link.enabled():
+                self.ctx.metrics.update(_link.get_table().signals())
+            self.ctx.metrics.update(get_walk_profiler().signals())
+        except Exception:  # noqa: BLE001 - telemetry must never kill training
+            pass
         try:
             from kungfu_tpu import monitor
 

@@ -72,7 +72,7 @@ def build_parser() -> argparse.ArgumentParser:
                         "(TPU_VISIBLE_DEVICES pinning; 0 = no pinning)")
     p.add_argument("-debug-port", type=int, default=-1,
                    help="HTTP endpoint: Stage dumps + /cluster/{metrics,"
-                        "trace,health} telemetry (0 = ephemeral)")
+                        "trace,health,links} telemetry (0 = ephemeral)")
     p.add_argument("-logdir", default="")
     p.add_argument("-q", "--quiet", action="store_true")
     p.add_argument("-delay", type=float, default=0.0)

@@ -54,6 +54,7 @@ class DebugServer:
     - ``/cluster/metrics`` federated Prometheus exposition (peer labels)
     - ``/cluster/trace``   cross-peer merged Chrome trace
     - ``/cluster/health``  per-peer step rate / straggler JSON
+    - ``/cluster/links``   k×k link matrix (per-edge bandwidth/latency)
     - anything else        the Stage/worker debug dump (old contract)
     """
 
@@ -71,6 +72,11 @@ class DebugServer:
             if path == "/cluster/health":
                 return (
                     json.dumps(agg.cluster_health(), indent=2),
+                    "application/json",
+                )
+            if path == "/cluster/links":
+                return (
+                    json.dumps(agg.cluster_links(), indent=2),
                     "application/json",
                 )
             if path == "/cluster/audit":
@@ -584,7 +590,7 @@ class Watcher:
             self._update_aggregator(initial)
             self.aggregator.start()
             log.info(
-                "kfrun: cluster telemetry: /cluster/{metrics,trace,health} "
+                "kfrun: cluster telemetry: /cluster/{metrics,trace,health,links} "
                 "on :%d (scrape every %.1fs)",
                 debug.port, self.aggregator.interval,
             )

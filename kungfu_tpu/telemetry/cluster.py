@@ -16,7 +16,12 @@ serves it from the watcher's debug endpoint:
   clock in an ``X-KF-Perf-Now-Us`` header; offset error <= RTT/2, and
   the stored offset only improves as lower-RTT scrapes land);
 - ``/cluster/health``  — JSON: per-peer step rate, step-time p50/p99,
-  bytes tx/rx, last-scrape age, straggler score/flag.
+  bytes tx/rx, last-scrape age, straggler score/flag;
+- ``/cluster/links``   — the k×k link matrix (ISSUE 6): every worker's
+  ``kungfu_link_*`` row (passive per-destination EWMA bandwidth/latency
+  from real collective traffic) merged into one document, with the
+  slowest edge called out — the input signal for straggler-adaptive
+  topology re-planning.
 
 On top of the snapshot the aggregator runs straggler detection
 (:mod:`~kungfu_tpu.telemetry.straggler`): rolling per-peer step-time
@@ -41,6 +46,7 @@ import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kungfu_tpu.telemetry import audit, log, metrics, promparse
+from kungfu_tpu.telemetry import link as tlink
 from kungfu_tpu.telemetry.straggler import StragglerScorer
 
 # metric families scraped off each worker's exposition
@@ -50,6 +56,12 @@ COLLECTIVE_SECONDS = "kungfu_collective_latency_seconds"
 EGRESS_BYTES = "kungfu_egress_bytes_total"
 INGRESS_BYTES = "kungfu_ingress_bytes_total"
 PEER_RTT = "kungfu_peer_rtt_seconds"
+# link-plane families (ISSUE 6): each worker's exposition carries its
+# own ROW of the link matrix; the aggregator assembles the k x k view
+LINK_BW = "kungfu_link_bandwidth_bytes_per_second"
+LINK_LAT = "kungfu_link_latency_seconds"
+LINK_BYTES = "kungfu_link_tx_bytes_total"
+LINK_MSGS = "kungfu_link_tx_messages_total"
 
 CLOCK_HEADER = "X-KF-Perf-Now-Us"
 
@@ -168,6 +180,9 @@ class PeerState:
         self.bytes_tx: Optional[float] = None
         self.bytes_rx: Optional[float] = None
         self.reported_rtt: Optional[float] = None  # median of its probes
+        # this peer's link-matrix row, parsed off its last exposition:
+        # {dst: {"bw":, "latency_s":, "tx_bytes":, "tx_messages":}}
+        self.links: Dict[str, dict] = {}
 
 
 class TelemetryAggregator:
@@ -358,6 +373,9 @@ class TelemetryAggregator:
             # federates whatever is stored, and a dead peer's last page
             # would keep it looking alive on the Prometheus view
             st.metrics_text = ""
+            # and its link row: a dead peer's frozen bandwidth estimates
+            # would keep steering topology re-planning hours later
+            st.links = {}
             self.scorer.drop(st.label)
             self.rtt_scorer.drop(st.label)
             return
@@ -373,6 +391,11 @@ class TelemetryAggregator:
         tx = rx = None
         coll_sum = None
         rtts = []
+        links: Dict[str, dict] = {}
+        _link_key = {
+            LINK_BW: "bw", LINK_LAT: "latency_s",
+            LINK_BYTES: "tx_bytes", LINK_MSGS: "tx_messages",
+        }
         for s in samples:
             if s.name == EGRESS_BYTES:
                 tx = (tx or 0.0) + s.value
@@ -384,6 +407,11 @@ class TelemetryAggregator:
                 coll_sum = (coll_sum or 0.0) + s.value
             elif s.name == PEER_RTT and math.isfinite(s.value) and s.value > 0:
                 rtts.append(s.value)
+            elif s.name in _link_key:
+                dst = s.labels_dict().get("dst")
+                if dst:
+                    links.setdefault(dst, {})[_link_key[s.name]] = s.value
+        st.links = links
         st.coll_sum = coll_sum
         st.bytes_tx, st.bytes_rx = tx, rx
         st.reported_rtt = sorted(rtts)[len(rtts) // 2] if rtts else None
@@ -653,6 +681,43 @@ class TelemetryAggregator:
             "peers": peers,
         }
 
+    def cluster_links(self) -> dict:
+        """The /cluster/links view: the k×k link matrix assembled from
+        every worker's exported row (no extra scrape — rows ride the
+        /metrics pages the aggregator already holds), plus the per-peer
+        clock offsets already estimated for /cluster/trace so offline
+        tooling can align link events without re-deriving them."""
+        doc = tlink.merge_matrix({st.label: st.links for st in self.peers()})
+        doc["wall_time"] = self._scraped_at
+        doc["clock_offset_us"] = {
+            st.label: st.clock_offset_us for st in self.peers()
+        }
+        return doc
+
+    def _links_summary(self) -> dict:
+        """Compact link signal for /cluster/health (the full matrix
+        stays on /cluster/links): the slowest measured edge and how many
+        edges have estimates at all. The election itself lives in ONE
+        place — tlink.merge_matrix — so this summary can never disagree
+        with /cluster/links about which edge is slowest. copy_edges=False:
+        this runs on every /cluster/health request (polled by every
+        worker), and a k=64 matrix is ~4k edge dicts we would copy only
+        to throw away."""
+        doc = tlink.merge_matrix(
+            {st.label: st.links for st in self.peers()}, copy_edges=False
+        )
+        edges = sum(
+            1
+            for row in doc["edges"].values()
+            for info in row.values()
+            if isinstance(info.get("bw"), (int, float)) and info["bw"] > 0
+        )
+        return {
+            "min_bw": doc["min_bw"],
+            "slowest_edge": doc["slowest_edge"],
+            "edges": edges,
+        }
+
     def cluster_health(self) -> dict:
         """The JSON health snapshot behind /cluster/health and
         monitor.cluster_health()."""
@@ -716,6 +781,7 @@ class TelemetryAggregator:
                 round(med * 1e3, 3) if med is not None else None
             ),
             "step_skew": self.scorer.skew(),
+            "links": self._links_summary(),
         }
 
 
@@ -833,4 +899,8 @@ def health_signals(
         },
         "cluster/self_straggler": me in stragglers if me else False,
     }
+    links = snap.get("links") or {}
+    if links.get("min_bw") is not None:
+        signals["links/min_bw"] = links["min_bw"]
+        signals["links/slowest_edge"] = links.get("slowest_edge")
     return signals

@@ -89,6 +89,32 @@ def trace_enabled() -> bool:
     return "trace" in features()
 
 
+# Per-step walk spans (host.rs.step / host.ag.step) are O(k * buckets)
+# per training iteration — at k=64 with a bucketed bert set that is
+# thousands of ring-buffer appends a step, evicting everything else from
+# the trace window on long runs. KF_TELEMETRY_SPAN_SAMPLE keeps one walk
+# in 1/rate fully annotated (deterministic, not random — resumable and
+# identical across reruns); the default 1.0 keeps current behavior.
+SPAN_SAMPLE_ENV = "KF_TELEMETRY_SPAN_SAMPLE"
+
+
+def span_sample() -> float:
+    """Fraction of walks whose per-step spans are emitted, in [0, 1].
+    Read per session epoch (not import time); malformed values fall back
+    to 1.0 — a typo must not silently blind the trace."""
+    raw = os.environ.get(SPAN_SAMPLE_ENV, "").strip()
+    if not raw:
+        return 1.0
+    try:
+        v = float(raw)
+    except ValueError:
+        from kungfu_tpu.telemetry import log
+
+        log.warn("%s: not a number: %r (keeping 1.0)", SPAN_SAMPLE_ENV, raw)
+        return 1.0
+    return min(max(v, 0.0), 1.0)
+
+
 def enable(*names: str) -> None:
     """Force features on programmatically (tests / embedding)."""
     cur = _cache["forced"] or features()

@@ -34,6 +34,30 @@ DEFAULT_BUCKETS = (
 
 _RESERVED = ("__",)
 
+# Cardinality guard (ISSUE 6 satellite): cap distinct label combinations
+# PER METRIC FAMILY. Per-peer families ({peer}, {dst}) grow linearly with
+# cluster size — at k=64 that is fine, but a bug (or labels built from
+# unbounded values like message names) would otherwise grow the registry
+# without limit and take /metrics scrape time and RSS with it. Beyond the
+# cap, label lookups return a shared detached child (increments are
+# accepted and discarded from the exposition) and the drop is counted in
+# ``kungfu_telemetry_dropped_series_total{metric}`` — a visible signal
+# instead of silent unbounded growth. Read at family-creation time.
+MAX_SERIES_ENV = "KF_TELEMETRY_MAX_SERIES"
+DEFAULT_MAX_SERIES = 512
+DROPPED_SERIES = "kungfu_telemetry_dropped_series_total"
+
+
+def max_series() -> int:
+    """Per-family label-set cap (0 disables the guard)."""
+    raw = os.environ.get(MAX_SERIES_ENV, "").strip()
+    if not raw:
+        return DEFAULT_MAX_SERIES
+    try:
+        return max(0, int(raw))
+    except ValueError:
+        return DEFAULT_MAX_SERIES
+
 
 def _validate_name(name: str) -> str:
     if not name or name.startswith(_RESERVED):
@@ -84,6 +108,18 @@ class _Metric:
         self.labelnames = tuple(labelnames)
         self._lock = threading.Lock()
         self._children: Dict[Tuple[str, ...], object] = {}
+        # cardinality guard state: the cap (0 = unguarded; the dropped-
+        # series counter itself is exempt — its cardinality is bounded by
+        # the family count), the shared overflow child handed to callers
+        # past the cap, and the registry to count drops into (set by
+        # Registry._get_or_create; standalone families use the global)
+        self._max_series = (
+            max_series()
+            if self.labelnames and name != DROPPED_SERIES
+            else 0
+        )
+        self._overflow_child = None
+        self._registry: Optional["Registry"] = None
         if not self.labelnames:
             # label-less families get their default child eagerly so they
             # always render (a registered counter at 0 is information)
@@ -106,17 +142,50 @@ class _Metric:
                 f"{self.name}: got {len(key)} label values, "
                 f"want {len(self.labelnames)}"
             )
+        dropped = False
         with self._lock:
             child = self._children.get(key)
             if child is None:
-                child = self._new_child()
-                self._children[key] = child
+                if self._max_series and len(self._children) >= self._max_series:
+                    # at the cap: hand back the shared detached child —
+                    # writes are accepted (call sites stay branch-free)
+                    # but never rendered — and count the drop below,
+                    # outside this family's lock
+                    if self._overflow_child is None:
+                        self._overflow_child = self._new_child()
+                    child = self._overflow_child
+                    dropped = True
+                else:
+                    child = self._new_child()
+                    self._children[key] = child
+        if dropped:
+            self._count_drop()
         return child
+
+    def _count_drop(self) -> None:
+        reg = self._registry if self._registry is not None else REGISTRY
+        try:
+            reg.counter(
+                DROPPED_SERIES,
+                "Label-set lookups rejected by the per-family cardinality "
+                "guard (KF_TELEMETRY_MAX_SERIES)",
+                ("metric",),
+            ).labels(self.name).inc()
+        except ValueError:
+            pass  # a colliding user family must not break the guard
 
     def _default(self):
         if self.labelnames:
             raise ValueError(f"{self.name} has labels {self.labelnames}; use .labels()")
         return self._children[()]
+
+    def remove(self, *labelvalues) -> None:
+        """Drop ONE labelled series from the exposition (label-population
+        churn, e.g. a link destination that left the cluster). No-op when
+        the series never existed; frees a slot under the cardinality cap."""
+        key = tuple(str(v) for v in labelvalues)
+        with self._lock:
+            self._children.pop(key, None)
 
     def clear_children(self) -> None:
         """Drop every labelled child (bounds cardinality when the label
@@ -368,6 +437,7 @@ class Registry:
                     )
                 return m
             m = cls(name, help, labelnames, **kw)
+            m._registry = self  # drop counting lands in the owning registry
             self._metrics[name] = m
             return m
 

@@ -63,6 +63,12 @@ class Client:
         from kungfu_tpu.monitor import net as _net
 
         self._monitor = _net.get_monitor() if _net.enabled() else None
+        # link plane (ISSUE 6): per-destination EWMA bandwidth/latency
+        # estimators fed by the real sends below — the k x k matrix's
+        # local row; rides the same telemetry gate as the monitor
+        from kungfu_tpu.telemetry import link as _link
+
+        self._links = _link.get_table() if _link.enabled() else None
         # latency histograms ride the same gate as the byte counters: a
         # histogram observe is a bisect + three adds, but the send path
         # runs per message and stays untouched when telemetry is off
@@ -206,11 +212,13 @@ class Client:
                 return Message(name=name, data=data, flags=flags)
             return Message(name=name, data=desc, flags=flags | Flags.SHM_REF)
 
+        dialed = False
         with lock:
             with self._pool_lock:
                 sock = self._pool.get(key)
             if sock is None:
                 sock = self._connect(peer, conn_type)
+                dialed = True
                 with self._pool_lock:
                     self._pool[key] = sock
                 if shm_conn:
@@ -229,6 +237,7 @@ class Client:
                 except OSError:
                     pass
                 sock = self._connect(peer, conn_type)
+                dialed = True
                 with self._pool_lock:
                     self._pool[key] = sock
                 if shm_conn:
@@ -240,6 +249,10 @@ class Client:
                 self._send_hist.observe(_dt)
         if self._monitor is not None:
             self._monitor.sent(peer, data_len)
+        if self._links is not None:
+            # a send that had to dial still counts its bytes, but is no
+            # bandwidth sample: connection setup is not link speed
+            self._links.observe_send(peer, data_len, 0.0 if dialed else _dt)
 
     def ping(self, peer: PeerID, timeout: float = 2.0) -> bool:
         try:
@@ -248,10 +261,11 @@ class Client:
             send_header(sock, ConnType.PING, self.self_id.host, self.self_id.port, 0)
             recv_ack(sock)
             sock.close()
+            rtt = time.perf_counter() - _t0
             if self._rtt_hist is not None:
-                self._rtt_hist.labels(str(peer)).observe(
-                    time.perf_counter() - _t0
-                )
+                self._rtt_hist.labels(str(peer)).observe(rtt)
+            if self._links is not None:
+                self._links.observe_latency(peer, rtt)
             return True
         except (ConnectionError, OSError):
             return False

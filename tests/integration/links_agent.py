@@ -1,0 +1,66 @@
+"""Link-plane agent (ISSUE 6 acceptance): drives big allreduces so the
+passive per-destination estimators see real >=64KiB collective traffic,
+asserts the worker-local adaptation signals (links/*, collective/*)
+landed in PolicyContext.metrics, then idles — refreshing its link row —
+until the harness signals it saw the populated /cluster/links matrix
+(KF_TEST_DONE_FILE), so the runner-side scrape window is bounded by the
+test, not a fixed sleep."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from kungfu_tpu import api
+
+
+def main() -> int:
+    rank = api.current_rank()
+    size = api.cluster_size()
+    expected = size * (size + 1) / 2
+
+    # large payloads: the per-peer segment sends stay over the 64KiB
+    # bandwidth-sample floor even at k=4 under a bf16 wire codec
+    for i in range(10):
+        out = api.all_reduce_array(
+            np.full(1_000_000, float(rank + 1), np.float32), name=f"links:{i}"
+        )
+        assert np.all(out == expected), f"allreduce wrong: {out[:4]}"
+
+    # worker-local half of the acceptance: the link row and the walk
+    # profiler surface through PolicyContext.metrics
+    from kungfu_tpu.policy import PolicyRunner
+
+    with PolicyRunner([], batch_size=8) as runner:
+        with runner.step():
+            pass
+    m = runner.ctx.metrics
+    assert m.get("links/min_bw", 0) > 0, sorted(m)
+    assert "links/slowest_edge" in m, sorted(m)
+    assert "collective/wait_frac" in m, sorted(m)
+    assert m.get("collective/efficiency", 0) > 0, sorted(m)
+    fr = m["collective/wait_frac"]
+    assert 0.0 <= fr <= 1.0, fr
+
+    # keep the link rows warm until the harness confirms the cluster
+    # matrix (or give up after 60s — the runner must still exit 0)
+    done_file = os.environ.get("KF_TEST_DONE_FILE", "")
+    deadline = time.time() + 60
+    i = 0
+    while time.time() < deadline:
+        if done_file and os.path.exists(done_file):
+            break
+        api.all_reduce_array(
+            np.full(200_000, 1.0, np.float32), name=f"keepalive:{i}"
+        )
+        i += 1
+        time.sleep(0.5)
+
+    api.run_barrier()
+    print(f"links agent done rank={rank}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

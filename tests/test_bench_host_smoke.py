@@ -55,3 +55,9 @@ def test_bench_host_ab_smoke(algo, wire):
     if wire:
         # compressed leg must also report the bytes the codec saved
         assert any("saved by codec" in l for l in wire_lines), r.stdout
+    # ISSUE 6: utilization, not just bytes — the EFF report attributes
+    # walk time (wait/compute/send) and names the strategy that ran
+    eff_lines = [l for l in r.stdout.splitlines() if "EFF " in l]
+    assert eff_lines, r.stdout
+    assert any(want_label in l and "wait" in l and "walks)" in l
+               for l in eff_lines), r.stdout

@@ -1,0 +1,866 @@
+"""Per-link observability plane + collective critical-path profiler
+(ISSUE 6).
+
+Covers:
+- LinkEstimator / LinkTable: EWMA convergence under bursty traffic,
+  the large-send bandwidth gate, dial exclusion, the per-table peer
+  cap, registry mirroring;
+- metrics-registry cardinality guard (KF_TELEMETRY_MAX_SERIES):
+  overflow children, the dropped-series counter, the 0-disables rule;
+- merge_matrix: missing peers, degenerate k=1, slowest-edge election;
+- WalkProfiler math: fraction clamping, the 2(k-1)/k*N efficiency
+  ratio, EWMA, wall-weighted signals; _SpanSampler determinism;
+- aggregator /cluster/links assembly (link rows parsed off the same
+  /metrics pages, clock offsets reused from the /cluster/trace
+  estimation), dead-peer row clearing, the /cluster/health links
+  summary and health_signals flattening;
+- `info links` rendering + URL derivation + one-shot over HTTP;
+- PolicyContext.metrics receiving the worker-local links/* and
+  collective/* signals;
+- live in-process clusters at np in {2,4}: profiler attribution
+  (wait/compute/send fractions sum to ~1.0) on segmented and tree
+  walks, and the link table fed by real transport traffic.
+"""
+
+import json
+import threading
+import urllib.request
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.telemetry import config as tconfig
+from kungfu_tpu.telemetry import link as tlink
+from kungfu_tpu.telemetry import metrics
+from kungfu_tpu.telemetry import cluster as tcluster
+from kungfu_tpu.telemetry import promparse
+from kungfu_tpu.telemetry.http import TelemetryServer
+
+MIB = 1 << 20
+
+
+# ---------------------------------------------------------------------------
+# link estimator / table
+# ---------------------------------------------------------------------------
+
+class TestLinkEstimator:
+    def table(self, **kw):
+        kw.setdefault("alpha", 0.2)
+        return tlink.LinkTable(registry=None, **kw)
+
+    def test_ewma_converges_after_burst(self):
+        """A link that degrades 100 -> 10 MiB/s is tracked within ~15
+        observations (alpha=0.2), and the estimate never undershoots."""
+        t = self.table()
+        for _ in range(10):
+            t.observe_send("w1", 1 * MIB, 1 / 100)  # 100 MiB/s
+        assert t.bandwidth("w1") == pytest.approx(100 * MIB, rel=0.01)
+        for _ in range(15):
+            t.observe_send("w1", 1 * MIB, 1 / 10)  # degraded: 10 MiB/s
+        bw = t.bandwidth("w1")
+        assert 9 * MIB < bw < 15 * MIB  # converged to the new regime
+
+    def test_ewma_rides_out_jitter(self):
+        """Alternating 90/110 MiB/s jitter keeps the estimate near the
+        mean instead of whipsawing to the last sample."""
+        t = self.table()
+        for i in range(40):
+            mibs = 90 if i % 2 == 0 else 110
+            t.observe_send("w1", 1 * MIB, 1 / mibs)
+        assert 85 * MIB < t.bandwidth("w1") < 115 * MIB
+
+    def test_small_sends_count_bytes_not_bandwidth(self):
+        """Sub-BW_MIN_BYTES frames measure per-message overhead, not the
+        pipe: bytes/messages accumulate, bandwidth stays unestimated."""
+        t = self.table()
+        for _ in range(50):
+            t.observe_send("w1", 100, 0.0001)
+        row = t.row()["w1"]
+        assert row["tx_bytes"] == 5000 and row["tx_messages"] == 50
+        assert row["bw"] is None and row["bw_samples"] == 0
+
+    def test_dialed_send_excluded_from_bandwidth(self):
+        """seconds<=0 marks a send that included a connection dial:
+        bytes count, the timing is not a bandwidth sample."""
+        t = self.table()
+        t.observe_send("w1", 1 * MIB, 0.0)
+        assert t.bandwidth("w1") is None
+        assert t.row()["w1"]["tx_bytes"] == 1 * MIB
+
+    def test_latency_ewma(self):
+        t = self.table()
+        t.observe_latency("w1", 0.010)
+        t.observe_latency("w1", 0.020)
+        # 0.2 * 0.020 + 0.8 * 0.010
+        assert t.row()["w1"]["latency_s"] == pytest.approx(0.012)
+        t.observe_latency("w1", -1.0)  # non-positive: ignored
+        assert t.row()["w1"]["latency_s"] == pytest.approx(0.012)
+
+    def test_min_bandwidth_and_restriction(self):
+        t = self.table()
+        t.observe_send("w1", 1 * MIB, 1 / 100)
+        t.observe_send("w2", 1 * MIB, 1 / 10)
+        t.observe_send("w3", 1000, 0.001)  # no estimate
+        assert t.min_bandwidth() == ("w2", pytest.approx(10 * MIB, rel=0.01))
+        dst, bw = t.min_bandwidth(["w1"])
+        assert dst == "w1" and bw == pytest.approx(100 * MIB, rel=0.01)
+        assert t.min_bandwidth(["w3"]) == (None, None)
+
+    def test_signals_shape(self):
+        t = self.table()
+        assert t.signals() == {}
+        t.observe_send("w2", 1 * MIB, 1 / 10)
+        sig = t.signals()
+        # always the cluster-plane [src, dst] shape; the local view only
+        # knows its own outgoing row, so src is None
+        assert sig["links/slowest_edge"] == [None, "w2"]
+        assert sig["links/min_bw"] == pytest.approx(10 * MIB, rel=0.01)
+
+    def test_registry_mirroring(self):
+        reg = metrics.Registry()
+        t = tlink.LinkTable(registry=reg, alpha=0.2)
+        t.observe_send("10.0.0.2:30001", 1 * MIB, 1 / 50)
+        t.observe_latency("10.0.0.2:30001", 0.003)
+        samples = promparse.parse_text(reg.render())
+        assert promparse.sample_value(
+            samples, "kungfu_link_tx_bytes_total", dst="10.0.0.2:30001"
+        ) == 1 * MIB
+        assert promparse.sample_value(
+            samples, "kungfu_link_tx_messages_total", dst="10.0.0.2:30001"
+        ) == 1
+        assert promparse.sample_value(
+            samples, "kungfu_link_bandwidth_bytes_per_second",
+            dst="10.0.0.2:30001",
+        ) == pytest.approx(50 * MIB, rel=0.01)
+        assert promparse.sample_value(
+            samples, "kungfu_link_latency_seconds", dst="10.0.0.2:30001"
+        ) == pytest.approx(0.003)
+
+    def test_peer_cap_drops_visibly(self):
+        reg = metrics.Registry()
+        t = tlink.LinkTable(registry=reg, max_peers=2)
+        t.observe_send("w1", 1000, 0.001)
+        t.observe_send("w2", 1000, 0.001)
+        t.observe_send("w3", 1000, 0.001)  # over the cap
+        assert set(t.row()) == {"w1", "w2"}
+        dropped = reg.get(metrics.DROPPED_SERIES)
+        assert dropped is not None
+        assert dropped.labels("kungfu_link_tx_bytes_total").value >= 1
+
+    def test_clear_resets(self):
+        t = self.table()
+        t.observe_send("w1", 1 * MIB, 0.01)
+        t.clear()
+        assert t.row() == {}
+
+    def test_prune_evicts_departed_peers(self):
+        """Elastic resize: a shed peer's frozen EWMA must stop winning
+        min_bandwidth and leave the exposition — the worker-side guard
+        matching the aggregator's dead-row clearing."""
+        reg = metrics.Registry()
+        t = tlink.LinkTable(registry=reg, alpha=0.2)
+        t.observe_send("w1", 1 * MIB, 1 / 100)
+        t.observe_send("w2", 1 * MIB, 1 / 10)  # slowest; about to leave
+        assert t.min_bandwidth()[0] == "w2"
+        t.prune(["w1", "w3"])  # new membership
+        assert set(t.row()) == {"w1"}
+        assert t.min_bandwidth()[0] == "w1"
+        text = reg.render()
+        assert 'dst="w2"' not in text  # stale gauges gone
+        assert 'dst="w1"' in text
+        # the departed peer re-joining starts a fresh estimator
+        t.observe_send("w2", 1 * MIB, 1 / 50)
+        assert t.row()["w2"]["tx_bytes"] == 1 * MIB
+
+    def test_prune_frees_peer_cap_slot(self):
+        t = tlink.LinkTable(registry=None, max_peers=2)
+        t.observe_send("w1", 1000, 0.001)
+        t.observe_send("w2", 1000, 0.001)
+        t.prune(["w2"])
+        t.observe_send("w4", 1000, 0.001)  # slot freed by the prune
+        assert set(t.row()) == {"w2", "w4"}
+
+
+# ---------------------------------------------------------------------------
+# registry cardinality guard
+# ---------------------------------------------------------------------------
+
+class TestCardinalityGuard:
+    def test_cap_enforced_and_counted(self, monkeypatch):
+        monkeypatch.setenv(metrics.MAX_SERIES_ENV, "3")
+        reg = metrics.Registry()
+        fam = reg.counter("kf_guard_total", "g", ("who",))
+        for i in range(3):
+            fam.labels(f"p{i}").inc()
+        overflow = fam.labels("p3")  # over the cap
+        overflow.inc(7)
+        text = reg.render()
+        assert 'kf_guard_total{who="p2"}' in text
+        assert "p3" not in text  # overflow child never renders
+        assert reg.counter(
+            metrics.DROPPED_SERIES, "", ("metric",)
+        ).labels("kf_guard_total").value == 1
+        # existing series still work past the cap
+        fam.labels("p0").inc()
+        samples = promparse.parse_text(reg.render())
+        assert promparse.sample_value(
+            samples, "kf_guard_total", who="p0"
+        ) == 2
+
+    def test_overflow_child_is_shared_and_writable(self, monkeypatch):
+        monkeypatch.setenv(metrics.MAX_SERIES_ENV, "1")
+        reg = metrics.Registry()
+        fam = reg.gauge("kf_guard_g", "g", ("who",))
+        fam.labels("a").set(1)
+        c1, c2 = fam.labels("b"), fam.labels("c")
+        assert c1 is c2  # one detached child, not one per rejected key
+        c1.set(9)  # accepted, discarded from exposition
+        assert "9" not in reg.render().split("kf_guard_g", 1)[1]
+
+    def test_zero_disables_guard(self, monkeypatch):
+        monkeypatch.setenv(metrics.MAX_SERIES_ENV, "0")
+        reg = metrics.Registry()
+        fam = reg.counter("kf_unguarded_total", "g", ("who",))
+        for i in range(600):
+            fam.labels(f"p{i}").inc()
+        assert reg.get(metrics.DROPPED_SERIES) is None
+
+    def test_default_cap(self, monkeypatch):
+        monkeypatch.delenv(metrics.MAX_SERIES_ENV, raising=False)
+        assert metrics.max_series() == metrics.DEFAULT_MAX_SERIES
+        monkeypatch.setenv(metrics.MAX_SERIES_ENV, "junk")
+        assert metrics.max_series() == metrics.DEFAULT_MAX_SERIES
+
+    def test_dropped_series_family_exempt(self, monkeypatch):
+        """The guard's own counter must not guard itself (its
+        cardinality is bounded by the family count)."""
+        monkeypatch.setenv(metrics.MAX_SERIES_ENV, "1")
+        reg = metrics.Registry()
+        fam = reg.counter(metrics.DROPPED_SERIES, "", ("metric",))
+        for i in range(5):
+            fam.labels(f"m{i}").inc()
+        assert fam.labels("m4").value == 1  # all five rendered distinct
+
+    def test_histogram_guard(self, monkeypatch):
+        monkeypatch.setenv(metrics.MAX_SERIES_ENV, "2")
+        reg = metrics.Registry()
+        fam = reg.histogram("kf_guard_seconds", "g", ("who",), buckets=(1.0,))
+        for i in range(4):
+            fam.labels(f"p{i}").observe(0.5)
+        assert reg.counter(
+            metrics.DROPPED_SERIES, "", ("metric",)
+        ).labels("kf_guard_seconds").value == 2
+
+    def test_labelless_families_unguarded(self, monkeypatch):
+        monkeypatch.setenv(metrics.MAX_SERIES_ENV, "1")
+        reg = metrics.Registry()
+        c = reg.counter("kf_plain_total", "g")
+        c.inc(3)
+        assert c.value == 3
+
+
+# ---------------------------------------------------------------------------
+# matrix merge
+# ---------------------------------------------------------------------------
+
+class TestMergeMatrix:
+    def test_merge_elects_slowest_edge(self):
+        rows = {
+            "a": {"b": {"bw": 100.0}, "c": {"bw": 10.0}},
+            "b": {"a": {"bw": 90.0}},
+            "c": {"a": {"bw": 80.0}},
+        }
+        doc = tlink.merge_matrix(rows)
+        assert doc["peers"] == ["a", "b", "c"]
+        assert doc["min_bw"] == 10.0
+        assert doc["slowest_edge"] == ["a", "c"]
+        assert doc["edges"]["a"]["b"]["bw"] == 100.0
+
+    def test_missing_peer_rows_tolerated(self):
+        """A fresh joiner (scraped, no link row yet) contributes no
+        edges; a peer only ever seen as a DESTINATION still makes the
+        peer list so the matrix has its column."""
+        rows = {
+            "a": {"b": {"bw": 50.0}, "d": {"bw": 60.0}},
+            "b": {},  # joined, nothing measured yet
+        }
+        doc = tlink.merge_matrix(rows)
+        assert doc["peers"] == ["a", "b", "d"]
+        assert list(doc["edges"]) == ["a"]
+        assert doc["min_bw"] == 50.0
+
+    def test_degenerate_single_peer(self):
+        doc = tlink.merge_matrix({"a": {}})
+        assert doc == {
+            "peers": ["a"], "edges": {}, "min_bw": None,
+            "slowest_edge": None,
+        }
+        assert tlink.merge_matrix({}) == {
+            "peers": [], "edges": {}, "min_bw": None, "slowest_edge": None,
+        }
+
+    def test_unestimated_edges_do_not_elect(self):
+        rows = {"a": {"b": {"bw": None, "tx_bytes": 500}}}
+        doc = tlink.merge_matrix(rows)
+        assert doc["min_bw"] is None
+        assert doc["edges"]["a"]["b"]["tx_bytes"] == 500
+
+
+# ---------------------------------------------------------------------------
+# walk profiler + span sampler
+# ---------------------------------------------------------------------------
+
+class TestWalkProfiler:
+    def prof(self):
+        from kungfu_tpu.collective.host_session import WalkProfiler
+
+        return WalkProfiler()
+
+    def test_fractions_sum_to_one(self):
+        p = self.prof()
+        p.record("all_reduce", "RING_SEGMENTED", 4, 4 * MIB,
+                 wall=1.0, wait=0.5, send=0.2)
+        s = p.snapshot()["all_reduce/RING_SEGMENTED"]
+        assert s["wait_frac"] == pytest.approx(0.5)
+        assert s["send_frac"] == pytest.approx(0.2)
+        assert s["compute_frac"] == pytest.approx(0.3)
+        assert s["wait_frac"] + s["send_frac"] + s["compute_frac"] \
+            == pytest.approx(1.0)
+
+    def test_jitter_clamped_to_wall(self):
+        """Measured wait+send can exceed wall by timer jitter; the
+        fractions must still sum to 1 with compute >= 0."""
+        p = self.prof()
+        p.record("all_reduce", "STAR", 2, MIB, wall=1.0, wait=0.8, send=0.4)
+        s = p.snapshot()["all_reduce/STAR"]
+        assert s["wait_frac"] + s["send_frac"] == pytest.approx(1.0)
+        assert s["compute_frac"] == pytest.approx(0.0, abs=1e-9)
+
+    def test_achieved_and_efficiency_math(self):
+        p = self.prof()
+        # k=4, N=4MiB: optimal volume = 2*(3/4)*4MiB = 6MiB. At link bw
+        # 12MiB/s the optimal transfer takes 0.5s; a 1s wall is 0.5 eff.
+        p.record("all_reduce", "RING_SEGMENTED", 4, 4 * MIB,
+                 wall=1.0, wait=0.1, send=0.1, link_bw=12 * MIB)
+        s = p.snapshot()["all_reduce/RING_SEGMENTED"]
+        assert s["achieved_gib_s"] == pytest.approx(6 * MIB / (1 << 30))
+        assert s["efficiency"] == pytest.approx(0.5)
+        assert s["efficiency_samples"] == 1
+
+    def test_efficiency_ewma(self):
+        p = self.prof()
+        for _ in range(30):
+            p.record("all_reduce", "STAR", 2, MIB,
+                     wall=1.0, wait=0.1, send=0.1, link_bw=2 * MIB)
+        # optimal = (2*(1/2)*1MiB)/(2MiB/s) = 0.5s -> eff 0.5, steady
+        assert p.snapshot()["all_reduce/STAR"]["efficiency"] \
+            == pytest.approx(0.5, rel=1e-6)
+
+    def test_degenerate_walks_ignored(self):
+        p = self.prof()
+        p.record("all_reduce", "STAR", 1, MIB, wall=1.0, wait=0.0, send=0.0)
+        p.record("all_reduce", "STAR", 2, MIB, wall=0.0, wait=0.0, send=0.0)
+        p.record("all_reduce", "STAR", 2, 0, wall=1.0, wait=0.0, send=0.0)
+        assert p.snapshot() == {}
+        assert p.signals() == {}
+
+    def test_signals_wall_weighted(self):
+        p = self.prof()
+        # 1s of walks at eff 0.8 + 3s of walks at eff 0.4 -> 0.5
+        p.record("all_reduce", "STAR", 2, MIB,
+                 wall=1.0, wait=0.5, send=0.0, link_bw=1.25 * MIB)
+        # k=4, N=2MiB: opt = 3MiB; at 2.5MiB/s that is 1.2s vs 3s wall
+        p.record("all_reduce", "RING_SEGMENTED", 4, 2 * MIB,
+                 wall=3.0, wait=0.6, send=0.0, link_bw=2.5 * MIB)
+        sig = p.signals()
+        assert sig["collective/efficiency"] == pytest.approx(0.5, rel=1e-6)
+        assert sig["collective/wait_frac"] == pytest.approx(1.1 / 4.0)
+
+    def test_publishes_metric_families(self):
+        from kungfu_tpu.collective.host_session import WalkProfiler
+
+        tconfig.refresh(forced=frozenset({"metrics"}))
+        try:
+            p = WalkProfiler()
+            p.record("all_reduce", "STAR", 2, MIB,
+                     wall=1.0, wait=0.25, send=0.25, link_bw=1 * MIB)
+            reg = metrics.get_registry()
+            fam = reg.get("kungfu_collective_walk_seconds_total")
+            assert fam.labels("all_reduce", "STAR", "wait").value \
+                == pytest.approx(0.25)
+            assert fam.labels("all_reduce", "STAR", "compute").value \
+                == pytest.approx(0.5)
+            eff = reg.get("kungfu_collective_efficiency_ratio")
+            assert eff.labels("all_reduce", "STAR").value == pytest.approx(1.0)
+        finally:
+            tconfig.refresh()
+
+    def test_reset(self):
+        p = self.prof()
+        p.record("all_reduce", "STAR", 2, MIB, wall=1.0, wait=0.1, send=0.1)
+        p.reset()
+        assert p.snapshot() == {}
+
+
+class TestSpanSampler:
+    def sampler(self, rate):
+        from kungfu_tpu.collective.host_session import _SpanSampler
+
+        return _SpanSampler(rate)
+
+    def test_rate_one_keeps_everything(self):
+        s = self.sampler(1.0)
+        assert all(s.sample() for _ in range(100))
+
+    def test_rate_zero_drops_everything(self):
+        s = self.sampler(0.0)
+        assert not any(s.sample() for _ in range(100))
+
+    @pytest.mark.parametrize("rate", [0.25, 0.1, 0.5])
+    def test_exact_fraction_evenly_spaced(self, rate):
+        s = self.sampler(rate)
+        picks = [s.sample() for _ in range(1000)]
+        assert sum(picks) == int(1000 * rate)
+        # evenly spaced: no gap between picks exceeds ceil(1/rate)+1
+        idx = [i for i, p in enumerate(picks) if p]
+        gaps = [b - a for a, b in zip(idx, idx[1:])]
+        assert max(gaps) <= int(1 / rate) + 1
+
+    def test_deterministic_across_instances(self):
+        a, b = self.sampler(0.3), self.sampler(0.3)
+        assert [a.sample() for _ in range(50)] \
+            == [b.sample() for _ in range(50)]
+
+    def test_env_parsing(self, monkeypatch):
+        monkeypatch.setenv(tconfig.SPAN_SAMPLE_ENV, "0.25")
+        assert tconfig.span_sample() == 0.25
+        monkeypatch.setenv(tconfig.SPAN_SAMPLE_ENV, "7")
+        assert tconfig.span_sample() == 1.0  # clamped
+        monkeypatch.setenv(tconfig.SPAN_SAMPLE_ENV, "junk")
+        assert tconfig.span_sample() == 1.0  # typo must not blind traces
+        monkeypatch.delenv(tconfig.SPAN_SAMPLE_ENV)
+        assert tconfig.span_sample() == 1.0
+
+
+# ---------------------------------------------------------------------------
+# aggregator: /cluster/links assembly
+# ---------------------------------------------------------------------------
+
+class LinkedWorker:
+    """In-process worker endpoint whose registry carries a link row."""
+
+    def __init__(self):
+        self.registry = metrics.Registry()
+        self.registry.counter(
+            "kungfu_steps_total", "Training steps completed by this worker"
+        ).inc(5)
+        self.links = tlink.LinkTable(registry=self.registry, alpha=1.0)
+        self.server = TelemetryServer(0, host="127.0.0.1",
+                                      registry=self.registry)
+        self.server.start()
+        self.label = f"127.0.0.1:{self.server.port}"
+        self.url = f"http://127.0.0.1:{self.server.port}"
+
+    def stop(self):
+        self.server.stop()
+
+
+@pytest.fixture
+def linked3():
+    workers = [LinkedWorker() for _ in range(3)]
+    # a full mesh except: w2 has no estimate toward w0 (bytes only)
+    w0, w1, w2 = workers
+    w0.links.observe_send(w1.label, 1 * MIB, 1 / 100)
+    w0.links.observe_send(w2.label, 1 * MIB, 1 / 10)  # slowest edge
+    w0.links.observe_latency(w1.label, 0.002)
+    w1.links.observe_send(w0.label, 1 * MIB, 1 / 90)
+    w1.links.observe_send(w2.label, 1 * MIB, 1 / 80)
+    w2.links.observe_send(w1.label, 1 * MIB, 1 / 70)
+    w2.links.observe_send(w0.label, 1000, 0.001)  # bytes, no estimate
+    agg = tcluster.TelemetryAggregator(interval=0.1,
+                                       registry=metrics.Registry())
+    agg.set_peers([(w.label, w.url) for w in workers])
+    try:
+        yield workers, agg
+    finally:
+        agg.stop()
+        for w in workers:
+            w.stop()
+
+
+class TestClusterLinks:
+    def test_matrix_assembled_from_scrapes(self, linked3):
+        workers, agg = linked3
+        agg.scrape_once()
+        doc = agg.cluster_links()
+        w0, w1, w2 = workers
+        assert set(doc["peers"]) == {w.label for w in workers}
+        assert doc["min_bw"] == pytest.approx(10 * MIB, rel=0.01)
+        assert doc["slowest_edge"] == [w0.label, w2.label]
+        assert doc["edges"][w0.label][w1.label]["bw"] \
+            == pytest.approx(100 * MIB, rel=0.01)
+        assert doc["edges"][w0.label][w1.label]["latency_s"] \
+            == pytest.approx(0.002)
+        # the unestimated edge still carries its byte counters
+        e = doc["edges"][w2.label][w0.label]
+        assert "bw" not in e or e.get("bw") in (None, 0)
+        assert e["tx_bytes"] == 1000 and e["tx_messages"] == 1
+
+    def test_clock_offsets_reused_from_trace_estimation(self, linked3):
+        """/cluster/links republishes the NTP-style offsets the trace
+        merge already estimated — offline tooling aligns link events
+        without re-deriving them."""
+        workers, agg = linked3
+        agg.scrape_once()
+        doc = agg.cluster_links()
+        offs = doc["clock_offset_us"]
+        assert set(offs) == {w.label for w in workers}
+        for st in agg.peers():
+            assert offs[st.label] == st.clock_offset_us
+            assert abs(offs[st.label]) < 1e6  # same box, same epoch
+        assert doc["wall_time"] is not None
+
+    def test_dead_peer_row_cleared(self, linked3):
+        """A dead worker's frozen bandwidth estimates must not keep
+        steering topology re-planning."""
+        workers, agg = linked3
+        agg.scrape_once()
+        dead = workers[0]
+        assert dead.label in agg.cluster_links()["edges"]
+        dead.stop()
+        agg.scrape_once()
+        doc = agg.cluster_links()
+        assert dead.label not in doc["edges"]
+        # still a column: live peers keep their estimates TOWARD it
+        assert dead.label in doc["peers"]
+        assert doc["min_bw"] == pytest.approx(70 * MIB, rel=0.01)
+
+    def test_health_carries_links_summary(self, linked3):
+        workers, agg = linked3
+        agg.scrape_once()
+        health = agg.cluster_health()
+        links = health["links"]
+        assert links["min_bw"] == pytest.approx(10 * MIB, rel=0.01)
+        assert links["slowest_edge"] == [workers[0].label, workers[2].label]
+        assert links["edges"] == 5  # the estimated edges only
+
+    def test_health_signals_flatten_links(self, linked3):
+        workers, agg = linked3
+        agg.scrape_once()
+        tcluster.set_aggregator(agg)
+        try:
+            sig = tcluster.health_signals(self_peer=workers[0].label)
+            assert sig["links/min_bw"] == pytest.approx(10 * MIB, rel=0.01)
+            assert sig["links/slowest_edge"] \
+                == [workers[0].label, workers[2].label]
+        finally:
+            tcluster.set_aggregator(None)
+
+    def test_cluster_links_endpoint(self, linked3):
+        from kungfu_tpu.runner.watch import DebugServer
+
+        workers, agg = linked3
+        agg.scrape_once()
+        srv = DebugServer(_StubWatcher(agg), 0)
+        srv.start()
+        try:
+            url = f"http://127.0.0.1:{srv.port}/cluster/links"
+            with urllib.request.urlopen(url, timeout=5) as r:
+                doc = json.loads(r.read().decode())
+                assert r.headers["Content-Type"].startswith("application/json")
+            assert set(doc["peers"]) == {w.label for w in workers}
+            assert doc["min_bw"] == pytest.approx(10 * MIB, rel=0.01)
+        finally:
+            srv.stop()
+
+
+class _StubWatcher:
+    def __init__(self, aggregator=None):
+        self.aggregator = aggregator
+
+    def debug_dump(self):
+        return {"self": "stub", "stages": [], "workers": {}}
+
+
+# ---------------------------------------------------------------------------
+# info links
+# ---------------------------------------------------------------------------
+
+class TestInfoLinks:
+    DOC = {
+        "peers": ["10.0.0.1:1", "10.0.0.2:1", "10.0.0.3:1"],
+        "edges": {
+            "10.0.0.1:1": {
+                "10.0.0.2:1": {"bw": 100.0 * MIB},
+                "10.0.0.3:1": {"bw": 10.0 * MIB},  # slow: under median/2
+            },
+            "10.0.0.2:1": {"10.0.0.1:1": {"bw": 90.0 * MIB}},
+            "10.0.0.3:1": {"10.0.0.1:1": {"bw": 95.0 * MIB}},
+        },
+        "min_bw": 10.0 * MIB,
+        "slowest_edge": ["10.0.0.1:1", "10.0.0.3:1"],
+    }
+
+    def test_render_matrix(self):
+        from kungfu_tpu.info.__main__ import render_links
+
+        out = render_links(self.DOC)
+        lines = out.splitlines()
+        assert "3 peers" in lines[0]
+        assert "slowest edge [0]→[2] at 10.0 MiB/s" in lines[0]
+        # the slow edge carries the marker; healthy edges don't
+        assert "10.0!" in out
+        assert "100.0!" not in out
+        row0 = [l for l in lines if l.strip().startswith("[0]")
+                and "100.0" in l][0]
+        assert "." in row0  # self cell
+        assert "-" in out  # unmeasured edges
+        assert "[2] 10.0.0.3:1" in out  # legend
+
+    def test_render_empty(self):
+        from kungfu_tpu.info.__main__ import render_links
+
+        assert "no peers" in render_links({"peers": [], "edges": {}})
+
+    def test_url_derivation(self, monkeypatch):
+        from kungfu_tpu.info.__main__ import _links_url
+
+        assert _links_url(["http://h:1/cluster/links"]) \
+            == "http://h:1/cluster/links"
+        assert _links_url(["http://h:1"]) == "http://h:1/cluster/links"
+        assert _links_url(["http://h:1/cluster/health"]) \
+            == "http://h:1/cluster/links"
+        monkeypatch.setenv("KF_CLUSTER_HEALTH_URL", "http://h:9/cluster/health")
+        assert _links_url([]) == "http://h:9/cluster/links"
+        monkeypatch.delenv("KF_CLUSTER_HEALTH_URL")
+        assert _links_url([]) == ""
+
+    def test_one_shot_over_http(self, linked3, capsys):
+        from kungfu_tpu.info.__main__ import _cmd_links
+        from kungfu_tpu.runner.watch import DebugServer
+
+        workers, agg = linked3
+        agg.scrape_once()
+        srv = DebugServer(_StubWatcher(agg), 0)
+        srv.start()
+        try:
+            rc = _cmd_links([f"http://127.0.0.1:{srv.port}"])
+        finally:
+            srv.stop()
+        assert rc == 0
+        out = capsys.readouterr().out
+        for w in workers:
+            assert w.label in out
+        assert "slowest edge" in out
+
+    def test_requires_url(self, monkeypatch, capsys):
+        from kungfu_tpu.info.__main__ import _cmd_links
+
+        monkeypatch.delenv("KF_CLUSTER_HEALTH_URL", raising=False)
+        assert _cmd_links([]) == 2
+
+
+# ---------------------------------------------------------------------------
+# policy integration: worker-local signals
+# ---------------------------------------------------------------------------
+
+class TestPolicySignals:
+    def test_local_link_and_profiler_signals_reach_policy(self, monkeypatch):
+        from kungfu_tpu.collective.host_session import get_walk_profiler
+        from kungfu_tpu.policy import PolicyRunner
+
+        monkeypatch.delenv("KF_CLUSTER_HEALTH_URL", raising=False)
+        tcluster.set_aggregator(None)
+        tconfig.refresh(forced=frozenset({"metrics"}))
+        prof = get_walk_profiler()
+        prof.reset()
+        table = tlink.LinkTable(registry=None)
+        monkeypatch.setattr(tlink, "_table", table)
+        try:
+            table.observe_send("10.0.0.9:1", 1 * MIB, 1 / 25)
+            prof.record("all_reduce", "RING_SEGMENTED", 4, 4 * MIB,
+                        wall=1.0, wait=0.4, send=0.1, link_bw=25 * MIB)
+            with PolicyRunner([], batch_size=8) as runner:
+                with runner.step():
+                    pass
+            m = runner.ctx.metrics
+            assert m["links/min_bw"] == pytest.approx(25 * MIB, rel=0.01)
+            assert m["links/slowest_edge"] == [None, "10.0.0.9:1"]
+            assert m["collective/wait_frac"] == pytest.approx(0.4)
+            assert 0 < m["collective/efficiency"] <= 1.0
+        finally:
+            prof.reset()
+            tconfig.refresh()
+
+    def test_stale_signals_evicted_when_sources_go_quiet(self, monkeypatch):
+        """A source that stops reporting (the only estimated peer was
+        pruned at a resize; the profiler was reset) must take its stale
+        ctx.metrics entries with it on the next refresh — a frozen
+        links/min_bw steering re-planning is the staleness
+        LinkTable.prune exists to prevent."""
+        from kungfu_tpu.collective.host_session import get_walk_profiler
+        from kungfu_tpu.policy import PolicyRunner
+
+        monkeypatch.delenv("KF_CLUSTER_HEALTH_URL", raising=False)
+        tcluster.set_aggregator(None)
+        tconfig.refresh(forced=frozenset({"metrics"}))
+        prof = get_walk_profiler()
+        prof.reset()
+        table = tlink.LinkTable(registry=None)
+        monkeypatch.setattr(tlink, "_table", table)
+        try:
+            table.observe_send("10.0.0.9:1", 1 * MIB, 1 / 25)
+            prof.record("all_reduce", "RING_SEGMENTED", 4, 4 * MIB,
+                        wall=1.0, wait=0.4, send=0.1, link_bw=25 * MIB)
+            with PolicyRunner([], batch_size=8) as runner:
+                with runner.step():
+                    pass
+                assert "links/min_bw" in runner.ctx.metrics
+                # the peer departs; its estimator is pruned; the
+                # profiler history is cleared
+                table.prune([])
+                prof.reset()
+                runner._signals_at = -1e9  # bypass the refresh throttle
+                with runner.step():
+                    pass
+            for key in ("links/min_bw", "links/slowest_edge",
+                        "collective/efficiency", "collective/wait_frac"):
+                assert key not in runner.ctx.metrics, key
+        finally:
+            prof.reset()
+            tconfig.refresh()
+
+    def test_no_signals_when_telemetry_off(self, monkeypatch):
+        from kungfu_tpu.collective.host_session import get_walk_profiler
+        from kungfu_tpu.policy import PolicyRunner
+
+        monkeypatch.delenv("KF_CLUSTER_HEALTH_URL", raising=False)
+        monkeypatch.delenv("KF_TELEMETRY", raising=False)
+        monkeypatch.delenv("KF_CONFIG_ENABLE_MONITORING", raising=False)
+        tcluster.set_aggregator(None)
+        tconfig.refresh()
+        get_walk_profiler().reset()
+        with PolicyRunner([], batch_size=8) as runner:
+            with runner.step():
+                pass
+        assert "links/min_bw" not in runner.ctx.metrics
+        assert "collective/efficiency" not in runner.ctx.metrics
+
+
+# ---------------------------------------------------------------------------
+# live walks: profiler attribution + transport-fed link table
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def live_clusters():
+    """In-process loopback clusters with telemetry forced on BEFORE the
+    transports are built (Client binds its link table at init)."""
+    from tests.test_segmented import make_peer_cluster
+
+    tconfig.refresh(forced=frozenset({"metrics"}))
+    tlink.get_table().clear()
+    built = {}
+
+    def get(n):
+        if n not in built:
+            built[n] = make_peer_cluster(n)
+        return built[n]
+
+    yield get
+    for ps in built.values():
+        for p in ps:
+            p.stop()
+    tconfig.refresh()
+
+
+def _allreduce_rounds(cluster, strategy, rounds, size, tag):
+    from kungfu_tpu.base.ops import ReduceOp
+    from kungfu_tpu.base.workspace import Workspace
+    from tests.test_segmented import _run_on_all, _sessions
+
+    sessions = _sessions(cluster, strategy)
+    np_ = len(cluster)
+
+    def run(r, sess):
+        for i in range(rounds):
+            x = np.full(size, float(r + 1), np.float32)
+            out = np.empty_like(x)
+            sess.all_reduce(Workspace(
+                send=x, recv=out, op=ReduceOp.SUM, name=f"{tag}:{i}",
+            ))
+            expected = np_ * (np_ + 1) / 2
+            assert out[0] == expected
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_profiler_attribution_segmented(np_, live_clusters, monkeypatch):
+    """Acceptance: segmented walks at np in {2,4} produce attribution
+    whose wait/compute/send fractions sum to ~1.0, plus a live achieved
+    throughput at the optimal bound."""
+    from kungfu_tpu.base.strategy import Strategy
+    from kungfu_tpu.collective.host_session import (
+        HostSession,
+        get_walk_profiler,
+    )
+
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    cluster = live_clusters(np_)
+    prof = get_walk_profiler()
+    prof.reset()
+    _allreduce_rounds(cluster, Strategy.RING_SEGMENTED, rounds=4,
+                      size=256 * 1024, tag=f"prof-seg-{np_}")
+    snap = prof.snapshot()
+    key = "all_reduce/RING_SEGMENTED"
+    assert key in snap, sorted(snap)
+    s = snap[key]
+    assert s["walks"] >= 4 * np_  # every peer's walks aggregate
+    assert s["wait_frac"] + s["send_frac"] + s["compute_frac"] \
+        == pytest.approx(1.0, abs=1e-6)
+    assert 0 <= s["wait_frac"] <= 1 and 0 <= s["send_frac"] <= 1
+    assert s["achieved_gib_s"] > 0
+    # real walks block on the ring at least somewhere
+    assert s["wait_frac"] + s["send_frac"] > 0
+
+
+@pytest.mark.parametrize("np_", [2, 4])
+def test_profiler_attribution_tree(np_, live_clusters):
+    from kungfu_tpu.base.strategy import Strategy
+    from kungfu_tpu.collective.host_session import get_walk_profiler
+
+    cluster = live_clusters(np_)
+    prof = get_walk_profiler()
+    prof.reset()
+    _allreduce_rounds(cluster, Strategy.BINARY_TREE, rounds=4,
+                      size=256 * 1024, tag=f"prof-tree-{np_}")
+    snap = prof.snapshot()
+    key = "all_reduce/BINARY_TREE"
+    assert key in snap, sorted(snap)
+    s = snap[key]
+    assert s["wait_frac"] + s["send_frac"] + s["compute_frac"] \
+        == pytest.approx(1.0, abs=1e-6)
+    assert s["walks"] >= 4 * np_
+
+
+def test_link_table_fed_by_live_transport(live_clusters):
+    """Real collective traffic populates the process link table: bytes
+    toward every peer actually sent to, and bandwidth estimates for the
+    >=64KiB segment sends."""
+    from kungfu_tpu.base.strategy import Strategy
+
+    cluster = live_clusters(4)
+    table = tlink.get_table()
+    table.clear()
+    _allreduce_rounds(cluster, Strategy.RING_SEGMENTED, rounds=6,
+                      size=1024 * 1024, tag="live-links")
+    row = table.row()
+    assert row, "no link traffic recorded"
+    labels = {str(p.self_id) for p in cluster}
+    assert set(row) <= labels  # dst labels are peer host:port strings
+    assert sum(e["tx_bytes"] for e in row.values()) > 4 * MIB
+    # at least one >=64KiB send timed cleanly into a bandwidth estimate
+    assert any(e["bw"] is not None and e["bw"] > 0 for e in row.values()), row
+    # ...and the registry page carries the row for the aggregator
+    samples = promparse.parse_text(metrics.get_registry().render())
+    assert any(s.name == "kungfu_link_tx_bytes_total" for s in samples)
