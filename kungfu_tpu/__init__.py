@@ -18,6 +18,17 @@ Reference capability map: see SURVEY.md at the repo root.
 
 __version__ = "0.1.0"
 
+from kungfu_tpu import knobs as _knobs
+
+# Debug-mode lock-order detector (ISSUE 7): installed FIRST, before any
+# kungfu module creates a lock, so every threading.Lock/RLock below this
+# line is instrumented. Unset/falsy knob = lockwatch never imported,
+# threading untouched, zero overhead (asserted by tests/test_lockwatch).
+if _knobs.get("KF_DEBUG_LOCKS"):
+    from kungfu_tpu.devtools import lockwatch as _lockwatch
+
+    _lockwatch.install()
+
 from kungfu_tpu.base.dtype import DType
 from kungfu_tpu.base.ops import ReduceOp
 from kungfu_tpu.base.strategy import Strategy

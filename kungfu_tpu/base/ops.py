@@ -42,7 +42,7 @@ def _load_native():
             from kungfu_tpu.base import _native_reduce
 
             _native = _native_reduce
-        except Exception:
+        except (ImportError, OSError):  # missing/stale .so: numpy path
             _native = False
     return _native
 

@@ -69,6 +69,9 @@ def launch_multiprocess(f: Callable[[int], None], np_: int) -> None:
     for p in procs:
         p.start()
     for p in procs:
+        # kfcheck: disable=KF302 — the workers ARE the foreground job; the
+        # launcher's contract is to block for their whole (unbounded)
+        # training run, and Ctrl-C interrupts the join
         p.join()
     bad = [(i, p.exitcode) for i, p in enumerate(procs) if p.exitcode != 0]
     if bad:
@@ -99,5 +102,7 @@ def _rank(rank: int) -> int:
         from kungfu_tpu import api
 
         return api.current_rank()
-    except Exception:  # noqa: BLE001 - heartbeats are best-effort
+    # kfcheck: disable=KF400 — heartbeats are best-effort: outside a
+    # cluster api.current_rank() has no peer and rank 0 is the contract
+    except Exception:  # noqa: BLE001
         return 0

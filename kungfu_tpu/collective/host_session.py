@@ -37,6 +37,7 @@ from kungfu_tpu.base.ops import (
 from kungfu_tpu.telemetry import config as tconfig
 from kungfu_tpu.telemetry import link as tlink
 from kungfu_tpu.telemetry import metrics as tmetrics
+from kungfu_tpu import knobs
 from kungfu_tpu.utils import trace
 from kungfu_tpu.base.strategy import Strategy
 from kungfu_tpu.collective.adaptive import AdaptiveState
@@ -58,7 +59,7 @@ from kungfu_tpu.utils.stall import stall_detect
 # concurrent chunk walks only pay when cores exist to run them; on a
 # 1-core host every extra in-flight chunk is pure context-switch cost.
 # KF_CONFIG_CHUNK_BYTES overrides the heuristic.
-CHUNK_BYTES = int(os.environ.get("KF_CONFIG_CHUNK_BYTES", "0"))
+CHUNK_BYTES = int(knobs.get("KF_CONFIG_CHUNK_BYTES"))
 _CHUNK_MIN = 1 << 20
 _CHUNK_MAX = 32 << 20
 DEFAULT_TIMEOUT = 120.0
@@ -76,15 +77,10 @@ _ALGO_STRATEGY = {
 
 
 def algo_override() -> Optional[Strategy]:
-    """Parse KF_CONFIG_ALGO (read per session epoch, not import time)."""
-    raw = os.environ.get("KF_CONFIG_ALGO", "").strip().lower()
-    try:
-        return _ALGO_STRATEGY[raw]
-    except KeyError:
-        raise ValueError(
-            f"KF_CONFIG_ALGO must be one of "
-            f"{sorted(k for k in _ALGO_STRATEGY if k)}, got {raw!r}"
-        ) from None
+    """Parse KF_CONFIG_ALGO (read per session epoch, not import time).
+    The registry's strict choice parser raises on a typo — fail fast,
+    not silently diverge the cluster."""
+    return _ALGO_STRATEGY[knobs.get("KF_CONFIG_ALGO")]
 
 
 # Wire codec (ISSUE 5 tentpole): f32 allreduce payloads travel the
@@ -102,15 +98,10 @@ _WIRE_DTYPE = {"bf16": DType.BF16, "f16": DType.F16, "auto": DType.BF16}
 
 
 def wire_override() -> str:
-    """Parse KF_CONFIG_WIRE (read per session epoch, not import time)."""
-    raw = os.environ.get("KF_CONFIG_WIRE", "").strip().lower()
-    if raw == "":
-        return "off"
-    if raw not in _WIRE_MODES:
-        raise ValueError(
-            f"KF_CONFIG_WIRE must be one of {sorted(_WIRE_MODES)}, got {raw!r}"
-        )
-    return raw
+    """Parse KF_CONFIG_WIRE (read per session epoch, not import time).
+    The registry's strict choice parser raises on a typo and resolves
+    unset/empty to "off"."""
+    return knobs.get("KF_CONFIG_WIRE")
 
 
 def choose_chunk_bytes(total: int) -> int:
@@ -750,17 +741,13 @@ class HostSession:
     # tree fallback graphs win. MUST be cluster-agreed (it decides which
     # rendezvous names a peer waits on) — like CHUNK_BYTES, the default
     # is a constant and the env override must be set fleet-wide.
-    SEGMENT_MIN_BYTES = int(
-        os.environ.get("KF_CONFIG_SEGMENT_MIN_BYTES", "") or (64 << 10)
-    )
+    SEGMENT_MIN_BYTES = int(knobs.get("KF_CONFIG_SEGMENT_MIN_BYTES"))
 
     # Codec floor: encoding pays two passes (encode + decode) to halve
     # the wire bytes, which only wins once the payload dwarfs the fixed
     # per-walk costs; tiny control collectives also stay exact this way.
     # Cluster-agreed like SEGMENT_MIN_BYTES (it decides message sizes).
-    WIRE_MIN_BYTES = int(
-        os.environ.get("KF_CONFIG_WIRE_MIN_BYTES", "") or (64 << 10)
-    )
+    WIRE_MIN_BYTES = int(knobs.get("KF_CONFIG_WIRE_MIN_BYTES"))
 
     def _segmented_active(self) -> bool:
         return (
@@ -806,7 +793,7 @@ class HostSession:
     # CPU-quota'd container, the phantom-parallelism trap auto_select
     # already avoids; KF_CONFIG_GROUP_WINDOW overrides
     GROUP_WINDOW = int(
-        os.environ.get("KF_CONFIG_GROUP_WINDOW", "")
+        knobs.get("KF_CONFIG_GROUP_WINDOW")
         or max(1, min(8, effective_cpu_count()))
     )
 
@@ -818,7 +805,7 @@ class HostSession:
     # buy a ~160x cut in message count. The reference runs one collective
     # per tensor and leans on cheap goroutines instead; bucketing is the
     # standard DDP/Horovod answer and is strictly better here.
-    FUSE_MIN_TENSORS = int(os.environ.get("KF_CONFIG_GROUP_FUSE_MIN", "4"))
+    FUSE_MIN_TENSORS = int(knobs.get("KF_CONFIG_GROUP_FUSE_MIN"))
 
     # Fused-bucket size cap: fused groups split into buckets that pack /
     # walk / unpack as a 3-stage pipeline, so the cap trades per-walk
@@ -830,9 +817,7 @@ class HostSession:
     # pipelining multi-hundred-MB sets (bert ~700 MB -> 11 buckets).
     # Part of the fused workspace name, so it MUST be cluster-agreed
     # like CHUNK_BYTES (which also rules out core-count scaling here).
-    GROUP_BUCKET_BYTES = int(
-        os.environ.get("KF_CONFIG_GROUP_BUCKET_BYTES", "") or (64 << 20)
-    )
+    GROUP_BUCKET_BYTES = int(knobs.get("KF_CONFIG_GROUP_BUCKET_BYTES"))
 
     def group_all_reduce(self, ws: Sequence[Workspace]) -> None:
         """Allreduce of many workspaces as one windowed group op (parity:
@@ -1311,8 +1296,7 @@ class HostSession:
         tuning (KF_CONFIG_GROUP_WINDOW — pure intra-host concurrency) is
         deliberately excluded: it may legitimately differ per host."""
         return [
-            ("KF_CONFIG_ALGO",
-             os.environ.get("KF_CONFIG_ALGO", "").strip().lower()),
+            ("KF_CONFIG_ALGO", knobs.get("KF_CONFIG_ALGO")),
             ("KF_CONFIG_CHUNK_BYTES", str(CHUNK_BYTES)),
             ("KF_CONFIG_SEGMENT_MIN_BYTES", str(self.SEGMENT_MIN_BYTES)),
             ("KF_CONFIG_GROUP_BUCKET_BYTES", str(self.GROUP_BUCKET_BYTES)),

@@ -1,0 +1,8 @@
+"""Developer tooling: project-specific static analysis (kfcheck) and
+runtime debug instrumentation (lockwatch).
+
+Nothing here is imported by the training path unless the operator asks
+for it: `python -m kungfu_tpu.devtools.kfcheck` is the analyzer's entry
+point, and `kungfu_tpu/__init__` imports lockwatch only under a truthy
+`KF_DEBUG_LOCKS`. See docs/devtools.md.
+"""

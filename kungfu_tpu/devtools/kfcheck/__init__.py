@@ -1,0 +1,34 @@
+"""kfcheck: project-wide static analysis for kungfu_tpu (ISSUE 7).
+
+The engine is a deeply multithreaded system whose failure modes (PRs
+4-6) were all hand-found concurrency bugs; generic linters know nothing
+about our lock hierarchy, knob registry or telemetry discipline. kfcheck
+is the project-specific layer: an AST-based driver with pluggable rules,
+a machine-readable findings format and inline suppressions that REQUIRE
+a written justification.
+
+Run: ``python -m kungfu_tpu.devtools.kfcheck [--json] [paths...]``
+
+Rule families (see docs/devtools.md):
+
+- KF0xx  driver/suppression hygiene (parse errors, bad suppressions)
+- KF1xx  config registry (KF_* knobs declared + read via kungfu_tpu.knobs)
+- KF2xx  lock discipline (no blocking under a lock, declared lock order)
+- KF3xx  thread lifecycle (daemon or bounded join, bounded waits)
+- KF4xx  exception hygiene (no silent broad excepts)
+- KF5xx  CLI surface (no bare print outside cli/info)
+- KF6xx  telemetry docs (metric families documented, no ghost rows)
+
+Suppression format, enforced::
+
+    # kfcheck: disable=KF201 — <why this is safe, in words>
+
+A suppression without a justification is itself a finding (KF001), and
+an unused suppression is a finding (KF003), so the suppression surface
+cannot rot.
+"""
+
+from kungfu_tpu.devtools.kfcheck.core import (  # noqa: F401
+    Finding,
+    run_project,
+)

@@ -1,0 +1,327 @@
+"""kfcheck driver: file walking, AST contexts, rule registry, inline
+suppressions and the findings model.
+
+Design: rules are plain functions registered with :func:`rule`. File
+rules get a :class:`FileContext` (path, source, AST, module constants,
+comment map); project rules get the :class:`Project` (every file context
+plus repo paths) and run once — they own cross-file invariants like
+"docs/knobs.md matches the registry".
+
+Suppressions are line-anchored comments::
+
+    x = risky()  # kfcheck: disable=KF200 — send timeout bounds the hold
+
+    # kfcheck: disable=KF301 — waiting ON the abort signal is abort-aware
+    flag.wait()
+
+A suppression must carry a justification after an em-dash/`--`/`-`
+separator; bare ``disable=KF200`` is a KF001 finding. Suppressions that
+match no finding are KF003 findings — a stale suppression hides nothing
+but still rots trust in the ones that matter. ``disable-file=`` scopes a
+rule off for a whole file (same justification contract).
+"""
+
+from __future__ import annotations
+
+import ast
+import dataclasses
+import io
+import json
+import os
+import re
+import tokenize
+from typing import Callable, Dict, Iterable, List, Optional, Tuple
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.dirname(
+    os.path.dirname(os.path.abspath(__file__)))))
+
+# rule ids for the driver's own meta findings
+PARSE_ERROR = "KF000"
+SUPPRESSION_NO_REASON = "KF001"
+SUPPRESSION_UNKNOWN_RULE = "KF002"
+SUPPRESSION_UNUSED = "KF003"
+
+_META_RULES = {
+    PARSE_ERROR: "file does not parse",
+    SUPPRESSION_NO_REASON: "suppression missing a written justification",
+    SUPPRESSION_UNKNOWN_RULE: "suppression names an unknown rule",
+    SUPPRESSION_UNUSED: "suppression matches no finding (stale)",
+}
+
+
+@dataclasses.dataclass(frozen=True)
+class Finding:
+    rule: str
+    path: str  # repo-relative, posix separators
+    line: int
+    message: str
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: {self.rule} {self.message}"
+
+    def to_json(self) -> dict:
+        return dataclasses.asdict(self)
+
+
+@dataclasses.dataclass
+class Suppression:
+    line: int  # line the comment sits on
+    rules: Tuple[str, ...]
+    reason: str
+    file_scope: bool
+    target: int  # code line covered (== line for trailing comments; the
+    # next non-comment/non-blank line for comment-only lines, so a
+    # justification may span several comment lines above the code)
+    used: bool = False
+
+    def covers(self, rule: str, line: int) -> bool:
+        if rule not in self.rules:
+            return False
+        if self.file_scope:
+            return True
+        return line == self.target
+
+
+_SUPPRESS_RE = re.compile(
+    r"#\s*kfcheck:\s*(disable(?:-file)?)\s*=\s*"
+    r"([A-Za-z0-9_,\s]*?)\s*(?:(?:—|–|--|-)\s*(.*))?$"
+)
+
+
+class FileContext:
+    def __init__(self, path: str, relpath: str, source: str):
+        self.path = path
+        self.relpath = relpath
+        self.source = source
+        self.tree: Optional[ast.AST] = None
+        self.parse_error: Optional[str] = None
+        try:
+            self.tree = ast.parse(source, filename=path)
+        except SyntaxError as e:
+            self.parse_error = f"{e.msg} (line {e.lineno})"
+        self.lines = source.splitlines()
+        self.suppressions: List[Suppression] = []
+        self.malformed: List[Finding] = []  # KF001 raised during parse
+        self._scan_comments()
+        # module-level NAME = "literal" constants (knob-name resolution)
+        self.str_constants: Dict[str, str] = {}
+        # local name -> (source module basename, original name) for
+        # `from pkg.mod import NAME [as alias]` — lets rules resolve
+        # constants imported from other analyzed modules
+        self.imported_names: Dict[str, Tuple[str, str]] = {}
+        if self.tree is not None:
+            for node in self.tree.body:
+                if (
+                    isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)
+                    and isinstance(node.value, ast.Constant)
+                    and isinstance(node.value.value, str)
+                ):
+                    self.str_constants[node.targets[0].id] = node.value.value
+                elif isinstance(node, ast.ImportFrom) and node.module:
+                    mod = node.module.rsplit(".", 1)[-1]
+                    for alias in node.names:
+                        self.imported_names[alias.asname or alias.name] = (
+                            mod, alias.name,
+                        )
+
+    def _scan_comments(self) -> None:
+        try:
+            tokens = tokenize.generate_tokens(io.StringIO(self.source).readline)
+            comments = [
+                (t.start[0], t.start[1], t.string)
+                for t in tokens
+                if t.type == tokenize.COMMENT
+            ]
+        except (tokenize.TokenError, IndentationError, SyntaxError):
+            return
+        for lineno, col, text in comments:
+            m = _SUPPRESS_RE.search(text)
+            if m is None:
+                if "kfcheck:" in text:
+                    self.malformed.append(Finding(
+                        SUPPRESSION_NO_REASON, self.relpath, lineno,
+                        f"unparseable kfcheck comment: {text.strip()!r}",
+                    ))
+                continue
+            kind, rules_raw, reason = m.group(1), m.group(2), m.group(3)
+            rules = tuple(
+                r.strip().upper() for r in rules_raw.split(",") if r.strip()
+            )
+            reason = (reason or "").strip()
+            if not rules or not reason:
+                self.malformed.append(Finding(
+                    SUPPRESSION_NO_REASON, self.relpath, lineno,
+                    "suppression must name rule(s) and carry a written "
+                    "justification: `# kfcheck: disable=KFxxx — <why>`",
+                ))
+                continue
+            target = lineno
+            if self.lines[lineno - 1].strip().startswith("#"):
+                # comment-only line: cover the next code line, skipping
+                # the rest of the justification block
+                target = lineno + 1
+                while target <= len(self.lines):
+                    stripped = self.lines[target - 1].strip()
+                    if stripped and not stripped.startswith("#"):
+                        break
+                    target += 1
+            self.suppressions.append(Suppression(
+                line=lineno,
+                rules=rules,
+                reason=reason,
+                file_scope=(kind == "disable-file"),
+                target=target,
+            ))
+
+    def walk(self) -> Iterable[ast.AST]:
+        if self.tree is None:
+            return ()
+        return ast.walk(self.tree)
+
+
+class Project:
+    """Everything the project-level rules need: the analyzed package,
+    the repo root (docs live there) and every parsed file."""
+
+    def __init__(self, pkg_root: str, repo_root: str,
+                 files: List[FileContext]):
+        self.pkg_root = pkg_root
+        self.repo_root = repo_root
+        self.files = files
+
+
+@dataclasses.dataclass(frozen=True)
+class Rule:
+    id: str
+    name: str
+    help: str
+    fn: Callable
+    scope: str  # "file" | "project"
+
+
+RULES: Dict[str, Rule] = {}
+
+
+def rule(id: str, name: str, help: str, *, scope: str = "file"):
+    """Register a rule. File rules: fn(ctx: FileContext) -> [Finding].
+    Project rules: fn(project: Project) -> [Finding]."""
+
+    def deco(fn):
+        if id in RULES:
+            raise ValueError(f"rule {id} registered twice")
+        RULES[id] = Rule(id=id, name=name, help=help, fn=fn, scope=scope)
+        return fn
+
+    return deco
+
+
+def known_rule_ids() -> List[str]:
+    return sorted(set(RULES) | set(_META_RULES))
+
+
+def _iter_py_files(root: str) -> Iterable[str]:
+    for dirpath, dirnames, filenames in os.walk(root):
+        dirnames[:] = sorted(
+            d for d in dirnames if d != "__pycache__" and not d.startswith(".")
+        )
+        for fn in sorted(filenames):
+            if fn.endswith(".py"):
+                yield os.path.join(dirpath, fn)
+
+
+def load_files(pkg_root: str, repo_root: str) -> List[FileContext]:
+    out = []
+    for path in _iter_py_files(pkg_root):
+        rel = os.path.relpath(path, repo_root).replace(os.sep, "/")
+        with open(path, encoding="utf-8") as f:
+            out.append(FileContext(path, rel, f.read()))
+    return out
+
+
+def _ensure_rules_loaded() -> None:
+    # import for side effect: each module registers its rules
+    from kungfu_tpu.devtools.kfcheck import rules as _rules  # noqa: F401
+
+
+def run_project(
+    pkg_root: Optional[str] = None,
+    repo_root: Optional[str] = None,
+    select: Optional[Iterable[str]] = None,
+) -> List[Finding]:
+    """Run every (selected) rule over the package; returns unsuppressed
+    findings plus suppression-hygiene findings, sorted by location."""
+    _ensure_rules_loaded()
+    repo_root = repo_root or REPO_ROOT
+    pkg_root = pkg_root or os.path.join(repo_root, "kungfu_tpu")
+    selected = set(select) if select else None
+
+    files = load_files(pkg_root, repo_root)
+    project = Project(pkg_root, repo_root, files)
+
+    findings: List[Finding] = []
+    raw: List[Finding] = []
+
+    for ctx in files:
+        findings.extend(ctx.malformed)
+        for sup in ctx.suppressions:
+            for rid in sup.rules:
+                if rid not in RULES and rid not in _META_RULES:
+                    findings.append(Finding(
+                        SUPPRESSION_UNKNOWN_RULE, ctx.relpath, sup.line,
+                        f"suppression names unknown rule {rid!r} "
+                        f"(known: {', '.join(known_rule_ids())})",
+                    ))
+        if ctx.parse_error is not None:
+            findings.append(Finding(
+                PARSE_ERROR, ctx.relpath, 1, ctx.parse_error))
+            continue
+        for r in RULES.values():
+            if r.scope != "file":
+                continue
+            if selected is not None and r.id not in selected:
+                continue
+            raw.extend(r.fn(ctx))
+
+    for r in RULES.values():
+        if r.scope != "project":
+            continue
+        if selected is not None and r.id not in selected:
+            continue
+        raw.extend(r.fn(project))
+
+    # apply suppressions
+    by_rel: Dict[str, FileContext] = {f.relpath: f for f in files}
+    for f in raw:
+        ctx = by_rel.get(f.path)
+        sup = None
+        if ctx is not None:
+            for s in ctx.suppressions:
+                if s.covers(f.rule, f.line):
+                    sup = s
+                    break
+        if sup is not None:
+            sup.used = True
+        else:
+            findings.append(f)
+
+    # stale suppressions (skip when a rule subset is selected: the rules
+    # that would have used them did not run)
+    if selected is None:
+        for ctx in files:
+            for s in ctx.suppressions:
+                if not s.used:
+                    findings.append(Finding(
+                        SUPPRESSION_UNUSED, ctx.relpath, s.line,
+                        f"suppression for {','.join(s.rules)} matches no "
+                        "finding — remove it (stale suppressions rot trust "
+                        "in the live ones)",
+                    ))
+
+    findings.sort(key=lambda f: (f.path, f.line, f.rule))
+    return findings
+
+
+def to_json(findings: List[Finding]) -> str:
+    return json.dumps([f.to_json() for f in findings], indent=2) + "\n"

@@ -1,0 +1,805 @@
+"""kfcheck rules: the project-specific invariants, one family per
+section (see docs/devtools.md for the operator-facing descriptions).
+
+Everything here is AST-shaped, not grep-shaped: docstrings and comments
+can mention ``print()`` or ``KF_FOO`` freely, only real call/literal
+nodes count. Rules err toward reporting — a false positive costs one
+justified suppression line, a false negative costs a 3am deadlock.
+
+Static limits, stated rather than hidden:
+
+- KF101 resolves environ keys that are string literals, module-level
+  constants, or ``module.CONST`` attributes of analyzed modules; a key
+  computed at runtime is invisible to it (KF100 still catches the
+  knob-name literal wherever it is spelled).
+- KF200/KF201 reason about ``with <lock>:`` blocks where the context
+  expression *names* a lock (its last segment contains ``lock``/
+  ``mutex``/``cond``); a lock hidden behind an arbitrary name is
+  invisible. The runtime detector (devtools/lockwatch.py) has no such
+  blind spot — the two layers are complementary.
+- KF300 accepts a thread as "provably joined" when the same module
+  joins a receiver of the same name with a bounded timeout; it does not
+  do interprocedural dataflow.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+import re
+from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
+
+from kungfu_tpu.devtools.kfcheck.core import (
+    FileContext,
+    Finding,
+    Project,
+    rule,
+)
+
+# ---------------------------------------------------------------------
+# shared AST helpers
+# ---------------------------------------------------------------------
+
+
+def _attr_chain(node: ast.AST) -> Optional[str]:
+    """Dotted name for Name/Attribute chains ("os.environ.get"), else
+    None."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def _last_segment(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Attribute):
+        return node.attr
+    if isinstance(node, ast.Name):
+        return node.id
+    return None
+
+
+def _kw(call: ast.Call, name: str) -> Optional[ast.expr]:
+    for k in call.keywords:
+        if k.arg == name:
+            return k.value
+    return None
+
+
+def _is_true(node: Optional[ast.expr]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is True
+
+
+def _is_false(node: Optional[ast.expr]) -> bool:
+    return isinstance(node, ast.Constant) and node.value is False
+
+
+def _has_timeout(call: ast.Call, *, positional_at: Optional[int] = None) -> bool:
+    if _kw(call, "timeout") is not None:
+        return True
+    if positional_at is not None and len(call.args) > positional_at:
+        return True
+    return False
+
+
+def _module_basename(relpath: str) -> str:
+    """"kungfu_tpu/telemetry/flight.py" -> "flight"; packages resolve to
+    their directory name so `from x import pkg` attribute reads work."""
+    base = os.path.basename(relpath)
+    if base == "__init__.py":
+        return os.path.basename(os.path.dirname(relpath))
+    return base[:-3] if base.endswith(".py") else base
+
+
+# ---------------------------------------------------------------------
+# KF1xx — config registry
+# ---------------------------------------------------------------------
+
+# a whole-string knob name: KF_WIRE, KF_CONFIG_ALGO ... but not the bare
+# "KF_"/"KF_CONFIG_" prefixes used for startswith() filters
+KNOB_RE = re.compile(r"^KF_[A-Z0-9_]*[A-Z0-9]$")
+
+# the registry itself is the only place allowed to spell environ
+# plumbing for knobs
+_REGISTRY_FILE = "kungfu_tpu/knobs.py"
+
+
+def _declared_knobs() -> Set[str]:
+    from kungfu_tpu import knobs
+
+    return set(knobs.names())
+
+
+@rule(
+    "KF100",
+    "undeclared-knob",
+    "every KF_* env literal must be declared in kungfu_tpu/knobs.py "
+    "(name, default, parser, doc) — scattered ad-hoc knobs are how 48 "
+    "of them went undocumented",
+    scope="project",
+)
+def check_knob_declared(project: Project) -> List[Finding]:
+    declared = _declared_knobs()
+    out = []
+    for ctx in project.files:
+        if ctx.relpath == _REGISTRY_FILE:
+            continue
+        for node in ctx.walk():
+            if not (isinstance(node, ast.Constant)
+                    and isinstance(node.value, str)):
+                continue
+            if KNOB_RE.match(node.value) and node.value not in declared:
+                out.append(Finding(
+                    "KF100", ctx.relpath, node.lineno,
+                    f"KF_* literal {node.value!r} is not declared in the "
+                    "knob registry (kungfu_tpu/knobs.py) — declare it "
+                    "with a default, parser and doc string",
+                ))
+    return out
+
+
+def _environ_read_key(node: ast.Call) -> Optional[ast.expr]:
+    """The key expression when `node` reads the environment
+    (os.environ.get / os.getenv), else None."""
+    chain = _attr_chain(node.func)
+    if chain in ("os.environ.get", "environ.get", "os.getenv", "getenv"):
+        return node.args[0] if node.args else None
+    return None
+
+
+def _resolve_key(
+    expr: Optional[ast.expr],
+    ctx: FileContext,
+    cross: Dict[str, Dict[str, str]],
+) -> Optional[str]:
+    if expr is None:
+        return None
+    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
+        return expr.value
+    if isinstance(expr, ast.Name):
+        if expr.id in ctx.str_constants:
+            return ctx.str_constants[expr.id]
+        imp = ctx.imported_names.get(expr.id)
+        if imp is not None:
+            return cross.get(imp[0], {}).get(imp[1])
+        return None
+    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
+        return cross.get(expr.value.id, {}).get(expr.attr)
+    return None
+
+
+@rule(
+    "KF101",
+    "env-read-bypasses-registry",
+    "KF_* environment variables are read only through kungfu_tpu.knobs "
+    "(get/raw/is_set) — direct os.environ reads re-invent parsing and "
+    "default semantics per call site",
+    scope="project",
+)
+def check_env_reads(project: Project) -> List[Finding]:
+    # module-basename -> {CONST: value} for `flight.DIR_ENV`-style keys
+    cross: Dict[str, Dict[str, str]] = {}
+    for ctx in project.files:
+        cross.setdefault(_module_basename(ctx.relpath), {}).update(
+            ctx.str_constants
+        )
+    out = []
+    for ctx in project.files:
+        if ctx.relpath == _REGISTRY_FILE:
+            continue
+        for node in ctx.walk():
+            key = None
+            if isinstance(node, ast.Call):
+                key = _environ_read_key(node)
+            elif (
+                isinstance(node, ast.Subscript)
+                and isinstance(node.ctx, ast.Load)
+                and _attr_chain(node.value) in ("os.environ", "environ")
+            ):
+                key = node.slice
+            if key is None:
+                continue
+            resolved = _resolve_key(key, ctx, cross)
+            if resolved is not None and resolved.startswith("KF_"):
+                out.append(Finding(
+                    "KF101", ctx.relpath, node.lineno,
+                    f"direct environment read of {resolved!r} — go "
+                    "through kungfu_tpu.knobs (get/raw/is_set) so "
+                    "parsing, defaults and docs stay single-sourced",
+                ))
+    return out
+
+
+@rule(
+    "KF102",
+    "knobs-doc-stale",
+    "docs/knobs.md is generated from the registry and must match it "
+    "byte-for-byte (regenerate: python -m kungfu_tpu.devtools.kfcheck "
+    "--write-knobs-doc)",
+    scope="project",
+)
+def check_knobs_doc(project: Project) -> List[Finding]:
+    from kungfu_tpu import knobs
+
+    doc_path = os.path.join(project.repo_root, "docs", "knobs.md")
+    rel = "docs/knobs.md"
+    if not os.path.exists(doc_path):
+        return [Finding(
+            "KF102", rel, 1,
+            "docs/knobs.md does not exist — generate it with "
+            "`python -m kungfu_tpu.devtools.kfcheck --write-knobs-doc`",
+        )]
+    with open(doc_path, encoding="utf-8") as f:
+        on_disk = f.read()
+    want = knobs.render_doc()
+    if on_disk != want:
+        # first differing line makes the finding actionable
+        lineno = 1
+        for i, (a, b) in enumerate(
+            zip(on_disk.splitlines(), want.splitlines()), start=1
+        ):
+            if a != b:
+                lineno = i
+                break
+        else:
+            lineno = min(len(on_disk.splitlines()),
+                         len(want.splitlines())) + 1
+        return [Finding(
+            "KF102", rel, lineno,
+            "docs/knobs.md is stale vs the registry — regenerate with "
+            "`python -m kungfu_tpu.devtools.kfcheck --write-knobs-doc`",
+        )]
+    return []
+
+
+# ---------------------------------------------------------------------
+# KF2xx — lock discipline
+# ---------------------------------------------------------------------
+
+_LOCKISH = re.compile(r"lock|mutex|(^|_)cond(ition)?$", re.IGNORECASE)
+
+
+def _lock_name(expr: ast.expr) -> Optional[str]:
+    """Last segment of a with-context expression when it names a lock
+    ("self._lock" -> "_lock"), else None."""
+    seg = _last_segment(expr)
+    if seg is not None and _LOCKISH.search(seg):
+        return seg
+    return None
+
+
+def _blocking_reason(call: ast.Call) -> Optional[str]:
+    """A short human label when `call` can block indefinitely (or for a
+    humanly-long time), else None."""
+    chain = _attr_chain(call.func)
+    if chain in ("time.sleep", "sleep"):
+        return "time.sleep"
+    if chain and chain.startswith("subprocess."):
+        return chain
+    if chain in ("urllib.request.urlopen", "request.urlopen", "urlopen"):
+        return "urlopen"
+    if not isinstance(call.func, ast.Attribute):
+        return None
+    attr = call.func.attr
+    if attr == "wait" and not call.args and not _has_timeout(call):
+        return ".wait() without timeout"
+    if attr == "wait_for" and not _has_timeout(call, positional_at=1):
+        return ".wait_for() without timeout"
+    if attr == "join" and not call.args and not _has_timeout(call):
+        return ".join() without timeout"
+    if attr == "get" and not call.args and not call.keywords:
+        # zero-arg .get() is a blocking queue get (dict.get needs a key)
+        return ".get() without timeout"
+    if attr in ("recv", "recv_into", "accept", "connect", "sendall"):
+        return f"socket .{attr}()"
+    return None
+
+
+class _LockWalker(ast.NodeVisitor):
+    """Tracks the stack of with-held locks while walking one file;
+    collects KF200 (blocking under a lock) and KF201 (hierarchy)
+    findings. Nested function bodies are walked with a FRESH stack:
+    a closure defined under a lock does not run under it."""
+
+    def __init__(self, ctx: FileContext, order: Sequence[str]):
+        self.ctx = ctx
+        self.order = list(order)
+        self.stack: List[Tuple[str, int]] = []  # (lock name, lineno)
+        self.findings: List[Finding] = []
+
+    # -- helpers
+
+    def _rank(self, name: str) -> Optional[int]:
+        try:
+            return self.order.index(name)
+        except ValueError:
+            return None
+
+    def _enter_lock(self, name: str, lineno: int) -> None:
+        if self.stack:
+            outer, outer_line = self.stack[-1]
+            if not self.order:
+                self.findings.append(Finding(
+                    "KF201", self.ctx.relpath, lineno,
+                    f"nested lock acquisition {outer!r} (line "
+                    f"{outer_line}) -> {name!r} but the module declares "
+                    "no lock hierarchy — add `_KF_LOCK_ORDER = "
+                    f"({outer!r}, {name!r})` at module level",
+                ))
+            else:
+                ro, ri = self._rank(outer), self._rank(name)
+                if ri is None:
+                    self.findings.append(Finding(
+                        "KF201", self.ctx.relpath, lineno,
+                        f"lock {name!r} acquired under {outer!r} but is "
+                        "not in the module's _KF_LOCK_ORDER declaration",
+                    ))
+                elif ro is None:
+                    self.findings.append(Finding(
+                        "KF201", self.ctx.relpath, lineno,
+                        f"lock {outer!r} (held at line {outer_line}) is "
+                        "not in the module's _KF_LOCK_ORDER declaration",
+                    ))
+                elif ri <= ro:
+                    self.findings.append(Finding(
+                        "KF201", self.ctx.relpath, lineno,
+                        f"lock order violation: {name!r} acquired while "
+                        f"holding {outer!r} (line {outer_line}), but "
+                        "_KF_LOCK_ORDER declares "
+                        f"{name!r} <= {outer!r}",
+                    ))
+        self.stack.append((name, lineno))
+
+    # -- visitors
+
+    def _fresh(self, node: ast.AST) -> None:
+        saved, self.stack = self.stack, []
+        self.generic_visit(node)
+        self.stack = saved
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self._fresh(node)
+
+    def visit_AsyncFunctionDef(self, node) -> None:
+        self._fresh(node)
+
+    def visit_Lambda(self, node: ast.Lambda) -> None:
+        self._fresh(node)
+
+    def visit_With(self, node: ast.With) -> None:
+        entered = 0
+        for item in node.items:
+            name = _lock_name(item.context_expr)
+            if name is not None:
+                self._enter_lock(name, node.lineno)
+                entered += 1
+        for stmt in node.body:
+            self.visit(stmt)
+        for _ in range(entered):
+            self.stack.pop()
+
+    visit_AsyncWith = visit_With
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if self.stack:
+            reason = _blocking_reason(node)
+            if reason is not None and not self._is_cond_wait_idiom(node):
+                held = self.stack[-1][0]
+                self.findings.append(Finding(
+                    "KF200", self.ctx.relpath, node.lineno,
+                    f"blocking call ({reason}) while holding lock "
+                    f"{held!r} — move the blocking work outside the "
+                    "critical section or bound it",
+                ))
+        self.generic_visit(node)
+
+    def _is_cond_wait_idiom(self, node: ast.Call) -> bool:
+        """`with cond: cond.wait[_for](...)` — Condition.wait RELEASES
+        the held lock for the duration, so it is not blocking-under-lock
+        (KF301 still judges its unboundedness)."""
+        if not (isinstance(node.func, ast.Attribute)
+                and node.func.attr in ("wait", "wait_for")):
+            return False
+        receiver = _last_segment(node.func.value)
+        return receiver is not None and receiver == self.stack[-1][0]
+
+
+def _declared_lock_order(ctx: FileContext) -> List[str]:
+    if ctx.tree is None:
+        return []
+    for node in ctx.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_KF_LOCK_ORDER"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return [
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+    return []
+
+
+@rule(
+    "KF200",
+    "blocking-under-lock",
+    "no call that can block indefinitely (sleep, subprocess, socket "
+    "recv/send, unbounded wait/join/get) while holding a lock — a "
+    "stalled peer must never extend a critical section",
+)
+def check_blocking_under_lock(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    w = _LockWalker(ctx, _declared_lock_order(ctx))
+    w.visit(ctx.tree)
+    return [f for f in w.findings if f.rule == "KF200"]
+
+
+@rule(
+    "KF201",
+    "lock-hierarchy",
+    "modules that nest lock acquisitions must declare the order as "
+    "`_KF_LOCK_ORDER = (outer, ..., inner)` and every nesting must "
+    "respect it — ABBA deadlocks are ordering bugs, caught here at "
+    "review time and by lockwatch at runtime",
+)
+def check_lock_hierarchy(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    w = _LockWalker(ctx, _declared_lock_order(ctx))
+    w.visit(ctx.tree)
+    return [f for f in w.findings if f.rule == "KF201"]
+
+
+# ---------------------------------------------------------------------
+# KF3xx — thread lifecycle
+# ---------------------------------------------------------------------
+
+
+def _is_thread_ctor(call: ast.Call) -> bool:
+    chain = _attr_chain(call.func)
+    return chain in ("threading.Thread", "Thread")
+
+
+@rule(
+    "KF300",
+    "thread-lifecycle",
+    "every threading.Thread is daemon=True or joined with a bounded "
+    "timeout — a forgotten non-daemon thread turns every crash into a "
+    "hang at interpreter exit",
+)
+def check_thread_lifecycle(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    # receivers that get `X.daemon = True` or a bounded `X.join(...)`
+    # anywhere in the module (same-name matching, not dataflow)
+    daemoned: Set[str] = set()
+    bounded_join: Set[str] = set()
+    assigned_to: Dict[int, str] = {}  # id(call node) -> receiver segment
+    for node in ctx.walk():
+        if isinstance(node, ast.Assign):
+            for tgt in node.targets:
+                if (
+                    isinstance(tgt, ast.Attribute)
+                    and tgt.attr == "daemon"
+                    and _is_true(node.value)
+                ):
+                    seg = _last_segment(tgt.value)
+                    if seg:
+                        daemoned.add(seg)
+                seg = _last_segment(tgt)
+                if seg and isinstance(node.value, ast.Call):
+                    assigned_to[id(node.value)] = seg
+        elif isinstance(node, ast.Call):
+            if (
+                isinstance(node.func, ast.Attribute)
+                and node.func.attr == "join"
+                and (node.args or _kw(node, "timeout") is not None)
+            ):
+                seg = _last_segment(node.func.value)
+                if seg:
+                    bounded_join.add(seg)
+    out = []
+    for node in ctx.walk():
+        if not (isinstance(node, ast.Call) and _is_thread_ctor(node)):
+            continue
+        if _is_true(_kw(node, "daemon")):
+            continue
+        seg = assigned_to.get(id(node))
+        if seg is not None and (seg in daemoned or seg in bounded_join):
+            continue
+        out.append(Finding(
+            "KF300", ctx.relpath, node.lineno,
+            "Thread created without daemon=True and without a bounded "
+            "join in this module — pass daemon=True or join it with a "
+            "timeout",
+        ))
+    return out
+
+
+@rule(
+    "KF301",
+    "unbounded-wait",
+    "every Event.wait/Condition.wait(_for)/Popen.wait is bounded — an "
+    "unbounded wait on a signal that never comes is a silent hang; "
+    "abort-aware waits get a justified suppression",
+)
+def check_unbounded_wait(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    out = []
+    for node in ctx.walk():
+        if not (isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)):
+            continue
+        attr = node.func.attr
+        if attr == "wait" and not node.args and not _has_timeout(node):
+            out.append(Finding(
+                "KF301", ctx.relpath, node.lineno,
+                "unbounded .wait() — pass a timeout (retry in a loop if "
+                "the wait is legitimate) so a lost signal cannot hang "
+                "this thread forever",
+            ))
+        elif attr == "wait_for" and not _has_timeout(node, positional_at=1):
+            out.append(Finding(
+                "KF301", ctx.relpath, node.lineno,
+                "unbounded .wait_for() — pass a timeout so a lost "
+                "notify cannot hang this thread forever",
+            ))
+    return out
+
+
+@rule(
+    "KF302",
+    "unbounded-join",
+    "every .join() is bounded — joining a thread/process that never "
+    "exits hangs shutdown paths; join with a timeout and handle the "
+    "still-alive case",
+)
+def check_unbounded_join(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    out = []
+    for node in ctx.walk():
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Attribute)
+            and node.func.attr == "join"
+            and not node.args
+            and not node.keywords
+        ):
+            out.append(Finding(
+                "KF302", ctx.relpath, node.lineno,
+                "unbounded .join() — pass a timeout and handle the "
+                "still-running case (log, escalate, or abandon as "
+                "daemon)",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# KF4xx — exception hygiene
+# ---------------------------------------------------------------------
+
+_LOG_FNS = frozenset({
+    "debug", "info", "warn", "warning", "error", "exception", "critical",
+    "fatal", "echo",
+})
+
+
+def _is_broad(handler: ast.ExceptHandler) -> Optional[str]:
+    t = handler.type
+    if t is None:
+        return "bare except:"
+    names = []
+    if isinstance(t, ast.Tuple):
+        names = [_last_segment(e) for e in t.elts]
+    else:
+        names = [_last_segment(t)]
+    for n in names:
+        if n in ("Exception", "BaseException"):
+            return f"except {n}"
+    return None
+
+
+def _handler_accounts(handler: ast.ExceptHandler) -> bool:
+    """True when the handler re-raises, logs, audits, exits, prints
+    (CLI surfaces), or *uses the bound exception* — capturing the error
+    into a list that a waiter re-raises is channeling, not swallowing."""
+    for node in ast.walk(handler):
+        if isinstance(node, ast.Raise):
+            return True
+        if isinstance(node, ast.Call):
+            chain = _attr_chain(node.func)
+            if chain in ("sys.exit", "os._exit"):
+                return True
+            if isinstance(node.func, ast.Attribute):
+                if node.func.attr in _LOG_FNS:
+                    return True
+                if node.func.attr == "record_event":
+                    return True
+            elif isinstance(node.func, ast.Name):
+                if node.func.id in _LOG_FNS | {"record_event", "print"}:
+                    return True
+        if (
+            handler.name is not None
+            and isinstance(node, ast.Name)
+            and isinstance(node.ctx, ast.Load)
+            and node.id == handler.name
+        ):
+            return True
+    return False
+
+
+@rule(
+    "KF400",
+    "silent-broad-except",
+    "a bare/broad except must log through telemetry.log, record an "
+    "audit event, or re-raise — errors that vanish here are the ones "
+    "postmortems cannot explain",
+)
+def check_silent_broad_except(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    out = []
+    for node in ctx.walk():
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        broad = _is_broad(node)
+        if broad is None:
+            continue
+        if _handler_accounts(node):
+            continue
+        out.append(Finding(
+            "KF400", ctx.relpath, node.lineno,
+            f"{broad} swallows without logging or re-raising — log via "
+            "telemetry.log, record an audit event, narrow the type, or "
+            "re-raise",
+        ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# KF5xx — CLI surface
+# ---------------------------------------------------------------------
+
+_PRINT_EXEMPT = ("kungfu_tpu/runner/cli.py",)
+_PRINT_EXEMPT_PREFIX = ("kungfu_tpu/info/",)
+
+
+@rule(
+    "KF500",
+    "bare-print",
+    "no bare print() outside the CLI surfaces (runner/cli.py, info/) — "
+    "everything else routes through kungfu_tpu.telemetry.log so output "
+    "is leveled, rank-prefixed and capturable",
+)
+def check_bare_print(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    if ctx.relpath in _PRINT_EXEMPT or ctx.relpath.startswith(
+        _PRINT_EXEMPT_PREFIX
+    ):
+        return []
+    out = []
+    for node in ctx.walk():
+        if (
+            isinstance(node, ast.Call)
+            and isinstance(node.func, ast.Name)
+            and node.func.id == "print"
+        ):
+            out.append(Finding(
+                "KF500", ctx.relpath, node.lineno,
+                "bare print() — use kungfu_tpu.telemetry.log (or "
+                "log.echo() for CLI result lines)",
+            ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# KF6xx — telemetry docs
+# ---------------------------------------------------------------------
+
+_METRIC_RE = re.compile(r'"(kungfu_[a-z0-9_]+[a-z0-9])"')
+
+# rendered by bespoke renderers (monitor/net.py rate gauges), not
+# registered via a string literal at one call site
+_RENDERED_ONLY = frozenset({"kungfu_egress_rate", "kungfu_ingress_rate"})
+
+
+def _source_metric_names(project: Project) -> Set[str]:
+    names: Set[str] = set()
+    for ctx in project.files:
+        names.update(_METRIC_RE.findall(ctx.source))
+    return names
+
+
+def _telemetry_doc(project: Project) -> Optional[Tuple[str, List[str]]]:
+    path = os.path.join(project.repo_root, "docs", "telemetry.md")
+    try:
+        with open(path, encoding="utf-8") as f:
+            text = f.read()
+    except OSError:
+        return None
+    return text, text.splitlines()
+
+
+@rule(
+    "KF600",
+    "metric-undocumented",
+    "every kungfu_* metric family registered anywhere in the package "
+    "appears in docs/telemetry.md — an undocumented family is invisible "
+    "to the operator staring at a dashboard at 3am",
+    scope="project",
+)
+def check_metrics_documented(project: Project) -> List[Finding]:
+    names = _source_metric_names(project)
+    out = []
+    if len(names) <= 30:
+        # the scan must keep finding the registry — a rename must not
+        # silently turn this rule into a no-op
+        out.append(Finding(
+            "KF600", "docs/telemetry.md", 1,
+            f"metric-name scan found only {len(names)} families — the "
+            "lexical scan looks broken (rename?), fix the rule before "
+            "trusting it",
+        ))
+        return out
+    got = _telemetry_doc(project)
+    if got is None:
+        return [Finding("KF600", "docs/telemetry.md", 1,
+                        "docs/telemetry.md is missing")]
+    doc, _ = got
+    for name in sorted(names):
+        if name not in doc:
+            out.append(Finding(
+                "KF600", "docs/telemetry.md", 1,
+                f"metric family {name!r} is registered in the package "
+                "but absent from docs/telemetry.md — add it to the "
+                "metrics table",
+            ))
+    return out
+
+
+@rule(
+    "KF601",
+    "metric-ghost-row",
+    "metric families named in docs/telemetry.md's table must still "
+    "exist in code — stale rows mislead operators as much as missing "
+    "ones",
+    scope="project",
+)
+def check_metric_ghosts(project: Project) -> List[Finding]:
+    names = _source_metric_names(project) | _RENDERED_ONLY
+    got = _telemetry_doc(project)
+    if got is None:
+        return []  # KF600 already reports the missing doc
+    _, lines = got
+    rows = [
+        (i, l) for i, l in enumerate(lines, start=1)
+        if l.startswith("| `kungfu_")
+    ]
+    out = []
+    if len(rows) <= 20:
+        out.append(Finding(
+            "KF601", "docs/telemetry.md", 1,
+            "metrics table not found where expected (fewer than 20 "
+            "`| \\`kungfu_...\\`` rows) — the doc layout moved, fix the "
+            "rule",
+        ))
+        return out
+    for lineno, row in rows:
+        for doc_name in re.findall(r"`(kungfu_[a-z0-9_]+)`",
+                                   row.split("|")[1]):
+            if doc_name not in names:
+                out.append(Finding(
+                    "KF601", "docs/telemetry.md", lineno,
+                    f"docs/telemetry.md documents {doc_name!r} but no "
+                    "code registers it — drop the stale row",
+                ))
+    return out

@@ -65,7 +65,9 @@ class Checkpointer:
             from kungfu_tpu import api
 
             return api.current_rank()
-        except Exception:  # noqa: BLE001 - usable without a cluster
+        # kfcheck: disable=KF400 — checkpointing is usable without a
+        # cluster; no api/peer means single-process rank 0 by contract
+        except Exception:  # noqa: BLE001
             return 0
 
     def save(self, step: int, state: Any, force: bool = False) -> bool:
@@ -80,7 +82,9 @@ class Checkpointer:
         """Newest step not beyond the cluster-wide safe resume epoch
         (KF_RECOVER_EPOCH, when the monitored runner provides one)."""
         steps = sorted(self.mgr.all_steps())
-        cap = os.environ.get(RECOVER_EPOCH_ENV, "")
+        from kungfu_tpu import knobs
+
+        cap = knobs.raw(RECOVER_EPOCH_ENV)
         if cap:
             steps = [s for s in steps if s <= int(cap)]
         return steps[-1] if steps else None

@@ -113,6 +113,8 @@ class ConfigServer:
         threading.Thread(target=self._watch_stop, daemon=True).start()
 
     def _watch_stop(self) -> None:
+        # kfcheck: disable=KF301 — this daemon thread waits ON the abort
+        # signal itself; stop() sets it, and process exit reaps the thread
         self.stop_event.wait()
         self.httpd.shutdown()
 
@@ -135,6 +137,8 @@ def main(argv=None) -> None:
     from kungfu_tpu.telemetry import log
 
     log.echo(f"config server on :{srv.port}")
+    # kfcheck: disable=KF301 — serving forever IS the program; the main
+    # thread waits on the abort signal and Ctrl-C interrupts the wait
     srv.stop_event.wait()
 
 

@@ -39,6 +39,8 @@ import sys
 import time
 import urllib.request
 
+from kungfu_tpu import knobs
+
 
 def _show_versions() -> None:
     import kungfu_tpu
@@ -90,7 +92,7 @@ def _show_telemetry(argv) -> None:
 
     feats = sorted(telemetry.features())
     print(f"telemetry: {','.join(feats) if feats else 'off'} "
-          f"(KF_TELEMETRY={os.environ.get('KF_TELEMETRY', '')!r})")
+          f"(KF_TELEMETRY={knobs.raw('KF_TELEMETRY')!r})")
     print("telemetry endpoints: http://<worker>:<peer_port+10000>"
           "/metrics | /trace | /audit")
     # an URL argument right after --telemetry: scrape a live worker
@@ -182,7 +184,7 @@ def _cmd_top(argv) -> int:
                   file=sys.stderr)
             return 2
     urls = [a for a in argv if a.startswith("http")]
-    url = urls[0] if urls else os.environ.get("KF_CLUSTER_HEALTH_URL", "")
+    url = urls[0] if urls else knobs.raw("KF_CLUSTER_HEALTH_URL")
     if not url:
         print(
             "info top: no /cluster/health URL — pass one, or run under "
@@ -271,7 +273,7 @@ def _links_url(argv) -> str:
     """Resolve the /cluster/links URL: explicit argument (full path or
     debug-endpoint base), else derived from KF_CLUSTER_HEALTH_URL."""
     urls = [a for a in argv if a.startswith("http")]
-    url = urls[0] if urls else os.environ.get("KF_CLUSTER_HEALTH_URL", "")
+    url = urls[0] if urls else knobs.raw("KF_CLUSTER_HEALTH_URL")
     if not url:
         return ""
     url = url.rstrip("/")
@@ -329,7 +331,7 @@ def _cmd_postmortem(argv) -> int:
 
     target = next(
         (a for a in argv if not a.startswith("-")), ""
-    ) or os.environ.get(flight.DIR_ENV, "")
+    ) or knobs.raw(flight.DIR_ENV)
     if not target:
         print(
             "info postmortem: no target — pass a telemetry run dir or a "

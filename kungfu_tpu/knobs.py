@@ -1,0 +1,439 @@
+"""Central registry of every ``KF_*`` environment knob (ISSUE 7).
+
+One module owns the whole configuration surface: each knob is declared
+exactly once with its name, default, parser and doc string, and every
+read in the package goes through :func:`get`/:func:`raw`.  Before this
+registry the 48 knobs were scattered across ~20 modules, each with its
+own ad-hoc ``os.environ.get(...) or default`` idiom — adding a knob
+meant inventing parsing semantics, and nothing kept docs/collectives.md
+and docs/telemetry.md env tables honest.  Now:
+
+- ``kfcheck`` (devtools) statically enforces that any exact ``KF_*``
+  string literal in the package is declared here (rule KF100) and that
+  no module reads ``os.environ`` with a ``KF_*`` key directly (KF101);
+- ``docs/knobs.md`` is *generated* from this registry
+  (``python -m kungfu_tpu.devtools.kfcheck --write-knobs-doc``) and
+  kfcheck fails when it goes stale (KF102).
+
+Semantics, shared by every knob: an UNSET or empty-string variable
+resolves to the declared default; a set value is parsed by the knob's
+parser.  A malformed value falls back to the default with a logged
+warning, except for ``strict`` knobs (cluster-agreed engine knobs like
+``KF_CONFIG_ALGO``) where a typo must fail fast rather than silently
+diverge the cluster — those raise ``ValueError``.
+
+This module must stay import-light (no kungfu_tpu imports at module
+level): the logger itself reads knobs from here.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import os
+from typing import Callable, Dict, List, Optional
+
+__all__ = [
+    "Knob", "declared", "names", "get", "raw", "is_set", "render_doc",
+]
+
+
+@dataclasses.dataclass(frozen=True)
+class Knob:
+    name: str
+    default: str  # env-level default (the string an unset var resolves to)
+    parse: Callable[[str], object]
+    doc: str
+    section: str
+    kind: str = "str"  # human-readable type for the generated doc
+    default_doc: str = ""  # display override when the default is dynamic
+    strict: bool = False  # parse errors raise instead of warn-and-default
+
+
+_REGISTRY: Dict[str, Knob] = {}
+_SECTIONS: List[str] = []  # insertion order for doc rendering
+
+
+def _knob(name, default, parse, doc, *, section, kind, default_doc="",
+          strict=False) -> None:
+    if name in _REGISTRY:
+        raise ValueError(f"knob {name} declared twice")
+    if section not in _SECTIONS:
+        _SECTIONS.append(section)
+    _REGISTRY[name] = Knob(
+        name=name, default=default, parse=parse, doc=doc, section=section,
+        kind=kind, default_doc=default_doc, strict=strict,
+    )
+
+
+# --- parsers -----------------------------------------------------------
+
+_TRUTHY = frozenset({"1", "true", "yes", "on", "y", "enabled"})
+
+
+def _bool(s: str) -> bool:
+    return str(s).strip().lower() in _TRUTHY
+
+
+def _int(s: str) -> int:
+    return int(str(s).strip())
+
+
+def _float(s: str) -> float:
+    return float(str(s).strip())
+
+
+def _int_bytes(s: str) -> int:
+    """Integer byte count; accepts float notation ("8e6")."""
+    return int(float(str(s).strip()))
+
+
+def _str(s: str) -> str:
+    return str(s)
+
+
+def _stripped(s: str) -> str:
+    return str(s).strip()
+
+
+def _csv(s: str) -> tuple:
+    return tuple(p.strip() for p in str(s).split(",") if p.strip())
+
+
+def _opt_int(s: str):
+    s = str(s).strip()
+    return int(s) if s else None
+
+
+def _choice(name: str, choices, *, empty_as: Optional[str] = None):
+    """Lowercased membership check; mirrors the engine's historical
+    fail-fast messages ("KF_CONFIG_ALGO must be one of [...], got ...")."""
+    allowed = tuple(choices)
+
+    def parse(s: str) -> str:
+        raw = str(s).strip().lower()
+        if raw == "" and empty_as is not None:
+            return empty_as
+        if raw not in allowed:
+            shown = sorted(c for c in allowed if c)
+            raise ValueError(
+                f"{name} must be one of {shown}, got {raw!r}"
+            )
+        return raw
+
+    return parse
+
+
+# --- declarations ------------------------------------------------------
+# Section order is the order of docs/knobs.md.
+
+_SEC_CONTRACT = "Worker contract (set by the runner)"
+_knob("KF_SELF_SPEC", "", _str,
+      "This worker's identity as `host:port`. Unset means single-process "
+      "fallback: the worker becomes a one-peer cluster of itself.",
+      section=_SEC_CONTRACT, kind="str")
+_knob("KF_INIT_PEERS", "", _str,
+      "Comma-separated initial peer list (`host:port,...`). Defaults to "
+      "`KF_SELF_SPEC` (a cluster of one).",
+      section=_SEC_CONTRACT, kind="str", default_doc="KF_SELF_SPEC")
+_knob("KF_INIT_RUNNERS", "", _str,
+      "Comma-separated runner (supervisor) endpoints.",
+      section=_SEC_CONTRACT, kind="str")
+_knob("KF_PARENT_ID", "", _str,
+      "The spawning runner's `host:port`, empty for orphan workers.",
+      section=_SEC_CONTRACT, kind="str")
+_knob("KF_INIT_CLUSTER_VERSION", "0", _int,
+      "Cluster version the worker starts at (bumped by every resize).",
+      section=_SEC_CONTRACT, kind="int")
+_knob("KF_INIT_PROGRESS", "0", _int,
+      "Training progress (steps) restored into the elastic state on start.",
+      section=_SEC_CONTRACT, kind="int")
+_knob("KF_ALLREDUCE_STRATEGY", "BINARY_TREE_STAR", _stripped,
+      "Initial collective strategy name (see `base/strategy.py`; "
+      "`AUTO` lets `auto_select` pick from the topology).",
+      section=_SEC_CONTRACT, kind="str")
+_knob("KF_DEVICE_SLOTS", "", _csv,
+      "Comma-separated accelerator chip ids this worker may open "
+      "(empty = unrestricted). Mirrored into `TPU_VISIBLE_DEVICES`.",
+      section=_SEC_CONTRACT, kind="csv")
+_knob("KF_SPAWN_TS", "", _str,
+      "Unix timestamp the runner spawned this worker at; start() reports "
+      "spawn→ready latency from it.",
+      section=_SEC_CONTRACT, kind="float-ts")
+_knob("KF_LOG_PREFIX", "", _str,
+      "Per-worker log prefix (`rank/np`), set by the runner; falls back "
+      "to `KF_SELF_SPEC`.",
+      section=_SEC_CONTRACT, kind="str")
+_knob("KF_RUNNER_PID", "0", _int,
+      "PID of the supervising runner (standby activation checks it).",
+      section=_SEC_CONTRACT, kind="int")
+
+_SEC_ELASTIC = "Elastic / adaptation"
+_knob("KF_CONFIG_SERVER", "", _str,
+      "Config-server URL for elastic membership proposals "
+      "(empty = static cluster).",
+      section=_SEC_ELASTIC, kind="url")
+_knob("KF_ELASTIC_MODE", "", _str,
+      "Resize style: empty (delta resize in-process) or `reload` "
+      "(workers restart on membership change).",
+      section=_SEC_ELASTIC, kind="str")
+_knob("KF_RECOVER_EPOCH", "", _str,
+      "Set by the monitored runner on relaunch: the minimum completed "
+      "epoch; checkpoint restore caps at it.",
+      section=_SEC_ELASTIC, kind="int")
+_knob("KF_MONITOR_ADDR", "", _str,
+      "Where `send_heartbeat` POSTs worker heartbeats "
+      "(set by the monitored runner).",
+      section=_SEC_ELASTIC, kind="host:port")
+_knob("KF_CONFIG_ENABLE_MONITORING", "", _bool,
+      "Truthy spelling enables the gradient-noise/variance monitor "
+      "(also implied by `KF_TELEMETRY=metrics`).",
+      section=_SEC_ELASTIC, kind="bool")
+_knob("KF_CONFIG_ENABLE_STALL_DETECTION", "", _bool,
+      "Truthy spelling logs collectives that exceed their deadline "
+      "repeatedly until they complete.",
+      section=_SEC_ELASTIC, kind="bool")
+
+_SEC_STANDBY = "Standby pool"
+_knob("KF_STANDBY_FIFO", "", _str,
+      "Path of the activation FIFO a standby worker blocks on "
+      "(`kf-standby` refuses to run without it).",
+      section=_SEC_STANDBY, kind="path")
+_knob("KF_STANDBY_PRELOAD", "", _csv,
+      "Extra modules a standby imports before parking, so activation "
+      "skips their import cost.",
+      section=_SEC_STANDBY, kind="csv")
+_knob("KF_ACTIVATED_TS", "", _str,
+      "Monotonic timestamp stamped by the standby pool at activation "
+      "(activation-latency accounting).",
+      section=_SEC_STANDBY, kind="float-ts")
+
+_SEC_LOG = "Logging"
+_knob("KF_LOG_LEVEL", "", _stripped,
+      "Log level (DEBUG/INFO/WARN/ERROR). Falls back to the reference's "
+      "`KF_CONFIG_LOG_LEVEL`.",
+      section=_SEC_LOG, kind="level", default_doc="KF_CONFIG_LOG_LEVEL")
+_knob("KF_CONFIG_LOG_LEVEL", "INFO", _stripped,
+      "Legacy (reference-parity) log level, used when `KF_LOG_LEVEL` "
+      "is unset.",
+      section=_SEC_LOG, kind="level")
+
+_SEC_TELEMETRY = "Telemetry"
+_knob("KF_TELEMETRY", "", _stripped,
+      "Telemetry feature selection: comma list of `metrics`, `trace`, "
+      "`audit`; `all`/any truthy value enables everything.",
+      section=_SEC_TELEMETRY, kind="csv")
+_knob("KF_TELEMETRY_DIR", "", _str,
+      "Per-run telemetry directory (flight-recorder journals, "
+      "postmortems). kfrun mints one under /tmp/kungfu-telemetry and "
+      "injects it into every worker.",
+      section=_SEC_TELEMETRY, kind="path")
+_knob("KF_TELEMETRY_MAX_SERIES", "512", _int,
+      "Cardinality guard: max distinct label-sets per metric family "
+      "(0 disables). Past the cap, lookups get a shared detached child "
+      "and `kungfu_telemetry_dropped_series_total` counts the drops.",
+      section=_SEC_TELEMETRY, kind="int")
+_knob("KF_TELEMETRY_SPAN_SAMPLE", "1.0", _float,
+      "Fraction of collective walks whose per-step spans are emitted, "
+      "in [0,1]; deterministic (not random) sampling.",
+      section=_SEC_TELEMETRY, kind="float")
+_knob("KF_TRACE_BUFFER", "8192", _int,
+      "Span ring-buffer capacity (events) for the /trace view.",
+      section=_SEC_TELEMETRY, kind="int")
+
+_SEC_FLIGHT = "Flight recorder"
+_knob("KF_FLIGHT", "", _bool,
+      "Explicit on/off override for the flight recorder; unset means "
+      "auto (on when `KF_TELEMETRY_DIR` is plumbed or any telemetry "
+      "feature is enabled).",
+      section=_SEC_FLIGHT, kind="bool", default_doc="auto")
+_knob("KF_FLIGHT_INTERVAL", "5.0", _float,
+      "Seconds between journal snapshots (a SIGKILL loses at most this "
+      "much history).",
+      section=_SEC_FLIGHT, kind="float")
+_knob("KF_FLIGHT_FSYNC", "", _bool,
+      "Truthy forces fsync after every journal frame (crash-safe at the "
+      "cost of write latency).",
+      section=_SEC_FLIGHT, kind="bool")
+_knob("KF_FLIGHT_MAX_BYTES", str(8 * 1024 * 1024), _int_bytes,
+      "Journal size bound; past it the journal rotates one generation.",
+      section=_SEC_FLIGHT, kind="int")
+
+_SEC_CLUSTER = "Cluster plane (runner-side aggregation)"
+_knob("KF_CLUSTER_HEALTH_URL", "", _str,
+      "The runner aggregator's debug endpoint base URL, injected into "
+      "every worker; workers pull cluster health signals from it and "
+      "`info top/links/postmortem` default to it.",
+      section=_SEC_CLUSTER, kind="url")
+_knob("KF_CLUSTER_SCRAPE_INTERVAL", "5.0", _float,
+      "Seconds between the aggregator's scrape sweeps over worker "
+      "telemetry endpoints.",
+      section=_SEC_CLUSTER, kind="float")
+
+_SEC_LINK = "Link observability"
+_knob("KF_LINK_BW_MIN_BYTES", str(64 << 10), _int,
+      "Sends smaller than this never feed the per-link bandwidth "
+      "estimator (control frames measure latency, not bandwidth).",
+      section=_SEC_LINK, kind="int")
+_knob("KF_LINK_EWMA_ALPHA", "0.2", _float,
+      "EWMA smoothing factor for per-link bandwidth/latency estimates.",
+      section=_SEC_LINK, kind="float")
+_knob("KF_LINK_MAX_PEERS", "256", _int,
+      "Max per-destination link estimators kept per worker.",
+      section=_SEC_LINK, kind="int")
+
+_SEC_ENGINE = "Collective engine (cluster-agreed)"
+_knob("KF_CONFIG_ALGO", "",
+      _choice("KF_CONFIG_ALGO", ("", "tree", "segmented", "auto")),
+      "Forces the collective algorithm family: `tree` (rank-0 graph "
+      "walks), `segmented` (ring reduce-scatter/all-gather), or `auto` "
+      "(topology heuristic). Unset: no override — the session keeps its "
+      "configured strategy. Cluster-agreed: checked by "
+      "`check_knob_consensus` at every session epoch.",
+      section=_SEC_ENGINE, kind="choice", strict=True,
+      default_doc="(unset: no override)")
+_knob("KF_CONFIG_WIRE", "",
+      _choice("KF_CONFIG_WIRE", ("off", "bf16", "f16", "auto"),
+              empty_as="off"),
+      "Compressed wire format for f32 allreduce payloads (bf16/f16 with "
+      "f32 ring accumulation); `auto` resolves to bf16 for eligible "
+      "payloads. Cluster-agreed.",
+      section=_SEC_ENGINE, kind="choice", strict=True, default_doc="off")
+_knob("KF_CONFIG_WIRE_MIN_BYTES", str(64 << 10), _int,
+      "Payloads below this bypass the wire codec (keeps probe-sized "
+      "monitored traffic exact). Cluster-agreed.",
+      section=_SEC_ENGINE, kind="int")
+_knob("KF_CONFIG_CHUNK_BYTES", "0", _int,
+      "Overrides the chunked-walk chunk size heuristic (0 = heuristic). "
+      "Cluster-agreed.",
+      section=_SEC_ENGINE, kind="int")
+_knob("KF_CONFIG_SEGMENT_MIN_BYTES", str(64 << 10), _int,
+      "Payloads below this fall back from the segmented ring to rank-0 "
+      "tree graphs (per-segment framing overhead dominates). "
+      "Cluster-agreed.",
+      section=_SEC_ENGINE, kind="int")
+_knob("KF_CONFIG_GROUP_WINDOW", "", _opt_int,
+      "Concurrent workspaces per batch in group collectives; default "
+      "scales with the cgroup-aware core count (min(8, cores)). "
+      "Local-only (not cluster-agreed).",
+      section=_SEC_ENGINE, kind="int", default_doc="min(8, cores)")
+_knob("KF_CONFIG_GROUP_FUSE_MIN", "4", _int,
+      "Minimum same-(dtype,op) tensors before group ops fuse them into "
+      "one contiguous walk. Cluster-agreed.",
+      section=_SEC_ENGINE, kind="int")
+_knob("KF_CONFIG_GROUP_BUCKET_BYTES", str(64 << 20), _int,
+      "Fused-bucket size cap for the 3-stage pack/walk/unpack pipeline. "
+      "Cluster-agreed (part of the fused workspace name).",
+      section=_SEC_ENGINE, kind="int")
+
+_SEC_TRANSPORT = "Transport / shared memory"
+_knob("KF_CONFIG_SHM", "1", lambda s: str(s).strip() != "0",
+      "Same-host transport rides a shared-memory ring unless this is "
+      "exactly `0`.",
+      section=_SEC_TRANSPORT, kind="bool")
+_knob("KF_CONFIG_SHM_CAPACITY", str(256 << 20), _int,
+      "Shared-memory arena size in bytes.",
+      section=_SEC_TRANSPORT, kind="int")
+_knob("KF_CONFIG_SHM_MIN_BYTES", str(256 << 10), _int,
+      "Frames smaller than this take the socket path (ring setup cost "
+      "beats small copies).",
+      section=_SEC_TRANSPORT, kind="int")
+
+_SEC_DEBUG = "Debug instrumentation"
+_knob("KF_DEBUG_LOCKS", "", _bool,
+      "Truthy installs the runtime lock-order detector "
+      "(`devtools/lockwatch.py`): wraps `threading.Lock/RLock`, builds "
+      "the cross-thread acquisition graph, reports ABBA cycles and "
+      "long-held locks as `lock_order_violation`/`lock_long_held` audit "
+      "events + `kungfu_debug_lock_*` metrics. Off = wrapper not "
+      "installed, zero overhead.",
+      section=_SEC_DEBUG, kind="bool")
+_knob("KF_DEBUG_LOCKS_HELD_MS", "1000", _float,
+      "Lock hold time (ms) past which the detector reports a long-held "
+      "lock.",
+      section=_SEC_DEBUG, kind="float")
+
+
+# --- accessors ---------------------------------------------------------
+
+def declared() -> Dict[str, Knob]:
+    """Name → Knob for every declared knob (a copy)."""
+    return dict(_REGISTRY)
+
+
+def names() -> List[str]:
+    return sorted(_REGISTRY)
+
+
+def is_set(name: str) -> bool:
+    """True when the variable is present in the environment (even empty).
+    Most callers want :func:`get`; this exists for the few tri-state
+    knobs (e.g. KF_FLIGHT: unset=auto, set=forced on/off)."""
+    _REGISTRY[name]  # KeyError on undeclared names: declare before use
+    return name in os.environ
+
+
+def raw(name: str) -> str:
+    """The raw string value: the environment's, or the declared default
+    when unset/empty."""
+    k = _REGISTRY[name]
+    v = os.environ.get(name)
+    if v is None or v.strip() == "":
+        return k.default
+    return v
+
+
+def get(name: str):
+    """Parsed knob value. Unset/empty resolves to the default; malformed
+    values warn and fall back to the default, except strict knobs
+    (cluster-agreed), which raise ValueError."""
+    k = _REGISTRY[name]
+    v = os.environ.get(name)
+    if v is None or v.strip() == "":
+        return k.parse(k.default)
+    try:
+        return k.parse(v)
+    except (ValueError, TypeError):
+        if k.strict:
+            raise
+        # import here, not at module level: the logger reads knobs too
+        from kungfu_tpu.telemetry import log
+
+        log.warn("%s: malformed value %r (keeping default %r)",
+                 name, v, k.default)
+        return k.parse(k.default)
+
+
+# --- doc generation ----------------------------------------------------
+
+_DOC_HEADER = """\
+# Configuration knobs
+
+<!-- GENERATED FILE — do not edit by hand.
+     Source of truth: kungfu_tpu/knobs.py.
+     Regenerate: python -m kungfu_tpu.devtools.kfcheck --write-knobs-doc
+     Staleness is enforced by kfcheck rule KF102 (tests/test_kfcheck.py). -->
+
+Every `KF_*` environment variable the system reads, generated from the
+central registry in `kungfu_tpu/knobs.py`. Unset or empty variables
+resolve to the default; malformed values warn and keep the default,
+except knobs marked **strict**, which fail fast (they are cluster-agreed
+— a typo'd peer must error, not silently diverge; see
+[docs/collectives.md](collectives.md) for the consensus check).
+
+Boolean knobs accept any truthy spelling (`1/true/yes/on/y/enabled`).
+"""
+
+
+def render_doc() -> str:
+    out = [_DOC_HEADER]
+    for section in _SECTIONS:
+        out.append(f"\n## {section}\n")
+        out.append("| Knob | Type | Default | What it does |")
+        out.append("| --- | --- | --- | --- |")
+        for k in sorted((k for k in _REGISTRY.values()
+                         if k.section == section), key=lambda k: k.name):
+            default = k.default_doc or k.default or "(empty)"
+            kind = k.kind + (" · strict" if k.strict else "")
+            out.append(f"| `{k.name}` | {kind} | `{default}` | {k.doc} |")
+    out.append("")
+    return "\n".join(out)

@@ -97,7 +97,9 @@ class Peer:
     def start(self) -> None:
         import os
 
-        spawn_ts = os.environ.get("KF_SPAWN_TS", "")
+        from kungfu_tpu import knobs
+
+        spawn_ts = knobs.raw("KF_SPAWN_TS")
         if spawn_ts:
             # joiner-readiness latency: runner spawn (or standby
             # activation) -> host plane up; the term that bounds the
@@ -321,9 +323,12 @@ class Peer:
             try:
                 with urllib.request.urlopen(url, timeout=5) as resp:
                     return Cluster.loads(resp.read().decode())
-            except Exception:
+            except Exception as e:
                 if i + 1 < attempts:
                     time.sleep(0.3)
+                else:
+                    log.warn("config server unreachable after %d tries "
+                             "(%s): %s", attempts, url, e)
         return None
 
     def _wait_new_config(self, url: str) -> Cluster:

@@ -29,6 +29,8 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional
 
+from kungfu_tpu.telemetry import log
+
 
 class BasePolicy:
     def before_train(self, ctx: "PolicyContext") -> None: ...
@@ -127,13 +129,14 @@ class PolicyRunner:
             if _link.enabled():
                 self.ctx.metrics.update(_link.get_table().signals())
             self.ctx.metrics.update(get_walk_profiler().signals())
-        except Exception:  # noqa: BLE001 - telemetry must never kill training
-            pass
+        except Exception as e:  # noqa: BLE001 - telemetry must never kill training
+            log.debug("policy: walk/link signal refresh failed: %s", e)
         try:
             from kungfu_tpu import monitor
 
             signals = monitor.cluster_health()
-        except Exception:  # noqa: BLE001 - telemetry must never kill training
+        except Exception as e:  # noqa: BLE001 - telemetry must never kill training
+            log.debug("policy: cluster health fetch failed: %s", e)
             return
         if signals:
             self.ctx.metrics.update(signals)
@@ -178,8 +181,8 @@ class PolicyRunner:
 
                 if api.detached():
                     self.ctx.request_stop()
-            except Exception:
-                pass
+            except Exception as e:  # noqa: BLE001 - detach check is advisory
+                log.debug("policy: detach check failed: %s", e)
             if (
                 self.ctx.total_samples is not None
                 and self.ctx.trained_samples >= self.ctx.total_samples

@@ -163,7 +163,9 @@ def main(argv: Optional[List[str]] = None) -> int:
     # so unattended loops don't grow /tmp forever.
     from kungfu_tpu.telemetry import flight
 
-    if not os.environ.get(flight.DIR_ENV):
+    from kungfu_tpu import knobs
+
+    if not knobs.raw(flight.DIR_ENV):
         flight.prune_runs()
         os.environ[flight.DIR_ENV] = flight.default_run_dir()
 

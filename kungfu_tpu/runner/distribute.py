@@ -159,6 +159,9 @@ def main(argv: Optional[List[str]] = None) -> int:
         codes = []
         for p in procs:
             try:
+                # kfcheck: disable=KF301 — waiting for the remote worker
+                # to finish IS the job; SIGTERM/SIGALRM teardown() and
+                # KeyboardInterrupt bound it from outside
                 codes.append(p.wait())
             except KeyboardInterrupt:
                 teardown()

@@ -293,5 +293,7 @@ def send_heartbeat(
     if host or port:
         addr = f"{host or '127.0.0.1'}:{port or MONITOR_PORT}"
     else:
-        addr = os.environ.get(MONITOR_ADDR_ENV, "") or f"127.0.0.1:{MONITOR_PORT}"
+        from kungfu_tpu import knobs
+
+        addr = knobs.raw(MONITOR_ADDR_ENV) or f"127.0.0.1:{MONITOR_PORT}"
     _post(addr, f"{kind}:{rank}", timeout=2.0)

@@ -193,6 +193,8 @@ def run_all(procs: List[WorkerProc]) -> List[int]:
     codes = [None] * len(procs)
     try:
         for i, p in enumerate(procs):
+            # kfcheck: disable=KF301 — a training worker legitimately
+            # runs unboundedly; KeyboardInterrupt kills the batch below
             codes[i] = p.wait()
     except KeyboardInterrupt:
         for p in procs:

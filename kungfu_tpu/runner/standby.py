@@ -211,16 +211,22 @@ def _die_with_parent() -> None:
         # died-before-arm check against the EXPLICIT runner pid (a
         # getppid()==1 heuristic misfires when the runner IS pid 1,
         # e.g. a container entrypoint)
-        runner_pid = int(os.environ.get("KF_RUNNER_PID", "0"))
+        from kungfu_tpu import knobs
+
+        runner_pid = int(knobs.get("KF_RUNNER_PID"))
         if runner_pid > 0 and os.getppid() != runner_pid:
             sys.exit(0)  # runner died before the arm
-    except Exception:  # noqa: BLE001 - non-Linux: best-effort only
-        pass
+    except Exception as e:  # noqa: BLE001 - non-Linux: best-effort only
+        from kungfu_tpu.telemetry import log
+
+        log.debug("kf-standby: pdeathsig arm skipped: %s", e)
 
 
 def main() -> None:
     _die_with_parent()
-    fifo = os.environ.get("KF_STANDBY_FIFO", "")
+    from kungfu_tpu import knobs
+
+    fifo = knobs.raw("KF_STANDBY_FIFO")
     if not fifo:
         from kungfu_tpu.telemetry import log
 
@@ -246,7 +252,7 @@ def main() -> None:
     # "auto"/"none" are resolved by the POOL (resolve_preload); an unset
     # or empty env means no extra preloads — "" must stay a working
     # disable spelling for direct StandbyPool users
-    for mod in filter(None, os.environ.get("KF_STANDBY_PRELOAD", "").split(",")):
+    for mod in knobs.get("KF_STANDBY_PRELOAD"):
         try:
             __import__(mod)
         except ImportError as e:

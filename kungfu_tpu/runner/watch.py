@@ -14,6 +14,7 @@ import collections
 import json
 import os
 import queue
+import subprocess
 import threading
 import time
 from typing import Dict, List, Optional
@@ -206,7 +207,9 @@ class Watcher:
         # postmortems of dead workers are harvested from it. The seen
         # set keys on (peer, pid) so a respawned-then-dead-again peer
         # gets a fresh postmortem but one death is never double-counted.
-        self.telemetry_dir = os.environ.get("KF_TELEMETRY_DIR", "")
+        from kungfu_tpu import knobs
+
+        self.telemetry_dir = knobs.raw("KF_TELEMETRY_DIR")
         self._postmortemed: set = set()
         # cluster observability plane (ISSUE 2): rides the -debug-port
         # endpoint; scrapes every worker's /metrics|/trace|/audit and
@@ -419,7 +422,8 @@ class Watcher:
                 # -SIGKILL, not a stale None
                 try:
                     proc.proc.wait(timeout=1.0)
-                except Exception:  # noqa: BLE001 - still running or already reaped
+                except (subprocess.TimeoutExpired, OSError):
+                    # still running, or already reaped elsewhere
                     proc.proc.poll()
             code = proc.proc.returncode if proc is not None and proc.proc else None
             key = (str(w), proc.proc.pid if proc is not None and proc.proc else None)

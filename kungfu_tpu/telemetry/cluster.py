@@ -45,6 +45,7 @@ import time
 import urllib.request
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import audit, log, metrics, promparse
 from kungfu_tpu.telemetry import link as tlink
 from kungfu_tpu.telemetry.straggler import StragglerScorer
@@ -71,11 +72,8 @@ HEALTH_URL_ENV = "KF_CLUSTER_HEALTH_URL"
 
 
 def scrape_interval() -> float:
-    try:
-        v = float(os.environ.get(INTERVAL_ENV, "") or DEFAULT_INTERVAL)
-        return v if v > 0 else DEFAULT_INTERVAL
-    except ValueError:
-        return DEFAULT_INTERVAL
+    v = float(knobs.get(INTERVAL_ENV))
+    return v if v > 0 else DEFAULT_INTERVAL
 
 
 class _HistSnapshot:
@@ -842,7 +840,7 @@ def health_snapshot(max_age: float = 5.0, wait: bool = False) -> Optional[dict]:
     agg = get_aggregator()
     if agg is not None:
         return agg.cluster_health()
-    url = os.environ.get(HEALTH_URL_ENV, "")
+    url = knobs.raw(HEALTH_URL_ENV)
     if not url:
         return None
     now = time.monotonic()
@@ -881,7 +879,7 @@ def health_signals(
     snap = health_snapshot(max_age, wait=wait)
     if not snap:
         return {}
-    me = self_peer or os.environ.get("KF_SELF_SPEC", "")
+    me = self_peer or knobs.raw("KF_SELF_SPEC")
     stragglers = snap.get("stragglers", [])
     signals = {
         # refresh marker: consumers that must count SCRAPES (not steps)

@@ -15,8 +15,9 @@ the environment at runtime must call :func:`refresh`.
 
 from __future__ import annotations
 
-import os
 from typing import FrozenSet, Optional
+
+from kungfu_tpu import knobs
 
 TELEMETRY_ENV = "KF_TELEMETRY"
 KNOWN_FEATURES = frozenset({"metrics", "trace", "audit"})
@@ -31,7 +32,17 @@ def truthy(value) -> bool:
 
 
 def env_truthy(name: str, default: str = "") -> bool:
-    return truthy(os.environ.get(name, default))
+    """Truthiness of a DECLARED boolean knob (see kungfu_tpu/knobs.py;
+    undeclared names are an error — declare before use)."""
+    try:
+        raw = knobs.raw(name)
+    except KeyError:
+        raise KeyError(
+            f"{name} is not a declared knob — declare it in "
+            "kungfu_tpu/knobs.py (name, default, parser, doc) before "
+            "reading it; kfcheck rule KF100 enforces this for KF_* names"
+        ) from None
+    return truthy(raw or default)
 
 
 _cache: dict = {"features": None, "forced": None}
@@ -71,7 +82,7 @@ def features() -> FrozenSet[str]:
     if _cache["forced"] is not None:
         return _cache["forced"]
     if _cache["features"] is None:
-        _cache["features"] = _parse_features(os.environ.get(TELEMETRY_ENV, ""))
+        _cache["features"] = _parse_features(knobs.raw(TELEMETRY_ENV))
     return _cache["features"]
 
 
@@ -100,19 +111,10 @@ SPAN_SAMPLE_ENV = "KF_TELEMETRY_SPAN_SAMPLE"
 
 def span_sample() -> float:
     """Fraction of walks whose per-step spans are emitted, in [0, 1].
-    Read per session epoch (not import time); malformed values fall back
-    to 1.0 — a typo must not silently blind the trace."""
-    raw = os.environ.get(SPAN_SAMPLE_ENV, "").strip()
-    if not raw:
-        return 1.0
-    try:
-        v = float(raw)
-    except ValueError:
-        from kungfu_tpu.telemetry import log
-
-        log.warn("%s: not a number: %r (keeping 1.0)", SPAN_SAMPLE_ENV, raw)
-        return 1.0
-    return min(max(v, 0.0), 1.0)
+    Read per session epoch (not import time); the registry's lenient
+    parse warns and falls back to 1.0 on malformed values — a typo must
+    not silently blind the trace."""
+    return min(max(knobs.get(SPAN_SAMPLE_ENV), 0.0), 1.0)
 
 
 def enable(*names: str) -> None:

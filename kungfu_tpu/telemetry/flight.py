@@ -73,11 +73,13 @@ LOG_TAIL = 60
 
 
 def _env_float(name: str, default: float) -> float:
-    try:
-        v = float(os.environ.get(name, "") or default)
-        return v if v > 0 else default
-    except ValueError:
-        return default
+    """Declared float knob, floored at the built-in default when the
+    configured value is non-positive (a zero snapshot interval or
+    journal bound would mean a busy loop / instant rotation)."""
+    from kungfu_tpu import knobs
+
+    v = float(knobs.get(name))
+    return v if v > 0 else default
 
 
 def sanitize_label(label: str) -> str:
@@ -431,13 +433,19 @@ class FlightRecorder:
                 self.journal.append(
                     self._snapshot_record("exit", reason=reason)
                 )
-            except Exception:  # noqa: BLE001 - dying anyway; journal best-effort
+            # kfcheck: disable=KF400 — SIGTERM/atexit teardown: the
+            # journal append is best-effort and logging can itself fail
+            # mid-death; the journal's absence IS the postmortem signal
+            except Exception:  # noqa: BLE001
                 pass
             self._closed = True
         self._stop.set()
         try:
             atexit.unregister(self._atexit)
-        except Exception:  # noqa: BLE001 - interpreter teardown orderings
+        # kfcheck: disable=KF400 — atexit.unregister during interpreter
+        # teardown may race module clearing; nothing to report, nowhere
+        # reliable left to report it
+        except Exception:  # noqa: BLE001
             pass
         self.journal.close()
         if self._fault_file is not None:
@@ -461,10 +469,11 @@ _recorder_lock = threading.Lock()
 def flight_enabled() -> bool:
     """On when a telemetry dir is set (kfrun injects one) or any
     telemetry feature is enabled; KF_FLIGHT overrides both ways."""
-    raw = os.environ.get(FLIGHT_ENV)
-    if raw is not None and raw.strip() != "":
-        return truthy(raw)
-    if os.environ.get(DIR_ENV, ""):
+    from kungfu_tpu import knobs
+
+    if knobs.raw(FLIGHT_ENV).strip() != "":  # unset/empty = auto
+        return truthy(knobs.raw(FLIGHT_ENV))
+    if knobs.raw(DIR_ENV):
         return True
     from kungfu_tpu.telemetry import config
 
@@ -483,14 +492,16 @@ def start_recorder(
         if directory is None:
             if not flight_enabled():
                 return None
-            run_dir = os.environ.get(DIR_ENV, "")
+            from kungfu_tpu import knobs
+
+            run_dir = knobs.raw(DIR_ENV)
             if not run_dir:
                 # self-minted fallback (no runner plumbed a run dir):
                 # apply the same retention kfrun does, or every bare
                 # run grows the default base forever
                 prune_runs()
                 run_dir = default_run_dir()
-            label = peer or os.environ.get("KF_SELF_SPEC", "") or str(os.getpid())
+            label = peer or knobs.raw("KF_SELF_SPEC") or str(os.getpid())
             directory = peer_dir(run_dir, label)
         try:
             _recorder = FlightRecorder(directory, peer=peer, **kw).start()

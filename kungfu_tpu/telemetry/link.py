@@ -40,6 +40,7 @@ import os
 import threading
 from typing import Dict, List, Optional, Sequence, Tuple
 
+from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import config as tconfig
 from kungfu_tpu.telemetry import metrics as tmetrics
 
@@ -49,27 +50,17 @@ from kungfu_tpu.telemetry import metrics as tmetrics
 # api imports this module transitively, so an import-time read would
 # freeze the default for embedders that set the env programmatically.
 def _bw_min_bytes() -> int:
-    try:
-        return int(os.environ.get("KF_LINK_BW_MIN_BYTES", "") or (64 << 10))
-    except ValueError:
-        return 64 << 10
+    return int(knobs.get("KF_LINK_BW_MIN_BYTES"))
 
 # EWMA smoothing factor for bandwidth/latency estimates
 def _alpha() -> float:
-    try:
-        v = float(os.environ.get("KF_LINK_EWMA_ALPHA", "") or 0.2)
-    except ValueError:
-        return 0.2
-    return min(max(v, 0.01), 1.0)
+    return min(max(float(knobs.get("KF_LINK_EWMA_ALPHA")), 0.01), 1.0)
 
 
 # destination cap for the table itself (the registry's cardinality guard
 # backstops the exported families independently)
 def _max_peers() -> int:
-    try:
-        return max(1, int(os.environ.get("KF_LINK_MAX_PEERS", "") or 256))
-    except ValueError:
-        return 256
+    return max(1, int(knobs.get("KF_LINK_MAX_PEERS")))
 
 
 def enabled() -> bool:

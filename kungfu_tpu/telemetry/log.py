@@ -28,6 +28,8 @@ import time
 from collections import deque
 from typing import List, Optional, TextIO
 
+from kungfu_tpu import knobs
+
 LEVELS = {"DEBUG": 10, "INFO": 20, "WARN": 30, "WARNING": 30, "ERROR": 40, "OFF": 100}
 _COLORS = [31, 32, 33, 34, 35, 36]  # red..cyan, cycled by rank
 
@@ -43,8 +45,8 @@ _tail: "deque[str]" = deque(maxlen=TAIL_LINES)
 
 def _level() -> int:
     if _state["level"] is None:
-        name = os.environ.get(
-            "KF_LOG_LEVEL", os.environ.get("KF_CONFIG_LOG_LEVEL", "INFO")
+        name = (
+            knobs.raw("KF_LOG_LEVEL") or knobs.raw("KF_CONFIG_LOG_LEVEL")
         ).upper()
         _state["level"] = LEVELS.get(name, 20)
     return _state["level"]
@@ -70,9 +72,7 @@ def reset() -> None:
 
 def _prefix() -> str:
     if _state["prefix"] is None:
-        p = os.environ.get("KF_LOG_PREFIX", "") or os.environ.get(
-            "KF_SELF_SPEC", ""
-        )
+        p = knobs.raw("KF_LOG_PREFIX") or knobs.raw("KF_SELF_SPEC")
         if p and sys.stderr.isatty():
             try:
                 rank = int(p.split("/")[0])

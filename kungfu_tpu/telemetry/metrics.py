@@ -50,13 +50,9 @@ DROPPED_SERIES = "kungfu_telemetry_dropped_series_total"
 
 def max_series() -> int:
     """Per-family label-set cap (0 disables the guard)."""
-    raw = os.environ.get(MAX_SERIES_ENV, "").strip()
-    if not raw:
-        return DEFAULT_MAX_SERIES
-    try:
-        return max(0, int(raw))
-    except ValueError:
-        return DEFAULT_MAX_SERIES
+    from kungfu_tpu import knobs
+
+    return max(0, knobs.get(MAX_SERIES_ENV))
 
 
 def _validate_name(name: str) -> str:
@@ -484,8 +480,10 @@ class Registry:
         for fn in extras:
             try:
                 blocks.append(fn().rstrip("\n"))
-            except Exception:  # noqa: BLE001 - one bad renderer must not 500 /metrics
-                pass
+            except Exception as e:  # noqa: BLE001 - one bad renderer must not 500 /metrics
+                from kungfu_tpu.telemetry import log
+
+                log.debug("metrics: extra renderer failed: %s", e)
         return "\n".join(b for b in blocks if b) + "\n"
 
     def clear(self) -> None:

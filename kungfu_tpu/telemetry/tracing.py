@@ -27,10 +27,11 @@ import time
 from collections import deque
 from typing import Dict, List, NamedTuple, Optional, Tuple
 
-try:
-    MAX_EVENTS = int(os.environ.get("KF_TRACE_BUFFER", "8192") or 8192)
-except ValueError:  # malformed env must not kill worker startup
-    MAX_EVENTS = 8192
+from kungfu_tpu import knobs
+
+# malformed values warn and keep the default inside the registry, so a
+# typo cannot kill worker startup
+MAX_EVENTS = int(knobs.get("KF_TRACE_BUFFER"))
 
 
 class TraceEvent(NamedTuple):

@@ -14,6 +14,11 @@ import time
 from typing import Dict, Optional, Tuple
 
 from kungfu_tpu.plan.peer import PeerID
+
+# declared lock hierarchy (kfcheck KF201): the per-peer send lock is
+# held across a send; the pool-map lock only guards dict lookups inside
+# it and must never be the outer of the two
+_KF_LOCK_ORDER = ("lock", "_pool_lock")
 from kungfu_tpu.transport import shm
 from kungfu_tpu.utils import trace
 from kungfu_tpu.transport.message import (

@@ -45,24 +45,22 @@ from typing import Dict, Optional, Tuple
 
 import numpy as np
 
+from kungfu_tpu import knobs
+
 MAGIC = 0x4B46534D454D31  # "KFSMEM1"
 HEADER = 4096
 _HDR = struct.Struct("<QQQQ")  # magic, capacity, alloc_seq, consumed_seq
 
-DEFAULT_CAPACITY = int(
-    os.environ.get("KF_CONFIG_SHM_CAPACITY", str(256 << 20))
-)
+DEFAULT_CAPACITY = int(knobs.get("KF_CONFIG_SHM_CAPACITY"))
 # payloads below this stay on the socket (descriptor overhead + mmap
 # bookkeeping beat the copy savings for small frames)
-SHM_MIN_BYTES = int(os.environ.get("KF_CONFIG_SHM_MIN_BYTES", str(256 << 10)))
+SHM_MIN_BYTES = int(knobs.get("KF_CONFIG_SHM_MIN_BYTES"))
 
 DESC = struct.Struct("<QQQ")  # offset, length, advance
 
 
 def enabled() -> bool:
-    return os.environ.get("KF_CONFIG_SHM", "1") != "0" and os.path.isdir(
-        "/dev/shm"
-    )
+    return knobs.get("KF_CONFIG_SHM") and os.path.isdir("/dev/shm")
 
 
 class ArenaSpaceError(OSError):

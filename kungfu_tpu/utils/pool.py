@@ -21,6 +21,10 @@ import time
 from collections import defaultdict, deque
 from typing import Callable, Deque, Dict, List, Optional
 
+# declared lock hierarchy (kfcheck KF201): the executor takes the pool
+# lock first, then a parked worker's condition to hand the task over
+_KF_LOCK_ORDER = ("_lock", "cond")
+
 
 class _Worker:
     __slots__ = ("task", "cond", "dead")
@@ -58,8 +62,12 @@ class CachedThreadPool:
             w.task = None
             try:
                 task()
-            except BaseException:  # noqa: BLE001 - submit() wraps errors
-                pass
+            except BaseException as e:  # noqa: BLE001 - must not kill the worker
+                # submitted fns wrap their own errors; one escaping to
+                # here is a caller bug worth a trace, not silence
+                from kungfu_tpu.telemetry import log
+
+                log.error("pool: submitted task raised: %r", e)
             with self._lock:
                 self._idle.append(w)
             with w.cond:

@@ -12,14 +12,14 @@ import contextlib
 import threading
 import time
 
-from kungfu_tpu.telemetry import config as _tconfig
+from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import log as _log
 
 DEFAULT_PERIOD = 3.0
 
 
 def enabled() -> bool:
-    return _tconfig.env_truthy("KF_CONFIG_ENABLE_STALL_DETECTION")
+    return bool(knobs.get("KF_CONFIG_ENABLE_STALL_DETECTION"))
 
 
 @contextlib.contextmanager

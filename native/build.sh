@@ -5,10 +5,49 @@
 # .so missing the newer symbols degrades gracefully to the numpy paths
 # via the guarded ctypes loader (base/_native_reduce.py — asserted by
 # tests/test_wire_codec.py).
-# Usage: native/build.sh [CXX]
+#
+# Usage: native/build.sh [CXX]           release .so + pdeathsig shim
+#        native/build.sh --tsan [CXX]    ThreadSanitizer concurrency
+#                                        smoke (sanitizer_smoke.cpp +
+#                                        reduce.cpp), built into
+#                                        native/build/ and RUN
+#        native/build.sh --ubsan [CXX]   same under UBSan
+#
+# The sanitizer targets are the correctness gate ISSUE 7 added for the
+# codec kernels (the engine calls them concurrently from pool threads on
+# disjoint segments); tests/test_native_sanitizers.py invokes them
+# behind a compiler-capability skip. See docs/devtools.md.
 set -e
 cd "$(dirname "$0")"
+
+MODE=build
+case "${1:-}" in
+  --tsan) MODE=tsan; shift ;;
+  --ubsan) MODE=ubsan; shift ;;
+esac
 CXX=${1:-g++}
+
+if [ "$MODE" = tsan ] || [ "$MODE" = ubsan ]; then
+  mkdir -p build
+  if [ "$MODE" = tsan ]; then
+    SAN="-fsanitize=thread"
+    BIN=build/kf_tsan_smoke
+  else
+    SAN="-fsanitize=undefined -fno-sanitize-recover=undefined"
+    BIN=build/kf_ubsan_smoke
+  fi
+  # -O1 keeps the sanitizer's shadow instrumentation honest (-O3 can
+  # elide the very accesses under test); -march=native so the F16C bulk
+  # paths are the ones exercised when the host has them
+  $CXX $SAN -O1 -g -march=native -std=c++17 \
+      -o "$BIN" sanitizer_smoke.cpp reduce.cpp -lpthread
+  echo "built $BIN"
+  # any reported race/UB exits nonzero (the harness itself exits 0)
+  TSAN_OPTIONS="exitcode=66 halt_on_error=1" \
+  UBSAN_OPTIONS="halt_on_error=1" "./$BIN"
+  exit 0
+fi
+
 OUT=../kungfu_tpu/base/libkfnative.so
 $CXX -O3 -march=native -shared -fPIC -std=c++17 -o "$OUT" reduce.cpp mst.cpp io_pump.cpp
 echo "built $OUT"

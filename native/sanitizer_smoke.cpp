@@ -1,0 +1,85 @@
+// Concurrency smoke for the wire-codec kernels, built and run under
+// ThreadSanitizer / UBSan by `native/build.sh --tsan|--ubsan` (gated
+// test: tests/test_native_sanitizers.py).
+//
+// Mirrors how the engine actually drives the kernels: the segmented
+// walk's pool threads encode DISJOINT segments of one shared f32
+// buffer concurrently (RS sends overlap the predecessor recv), while
+// receive paths decode-accumulate into disjoint regions of a shared
+// accumulator. Any data race the codec introduces on that pattern —
+// a stray write outside [sb, se), hidden shared scratch state — is
+// exactly what TSan exists to catch and a Python test cannot.
+//
+// Exit 0 = ran to completion with correct sums; the sanitizer runtime
+// turns any race/UB into a nonzero exit (TSAN_OPTIONS=exitcode).
+
+#include <cmath>
+#include <cstdint>
+#include <cstdio>
+#include <cstdlib>
+#include <thread>
+#include <vector>
+
+extern "C" int kf_transform2(void *dst, const void *x, const void *y,
+                             int64_t count, int32_t dtype, int32_t op);
+extern "C" int kf_encode_wire(void *dst, const void *src, int64_t count,
+                              int32_t wire_dtype);
+extern "C" int kf_decode_wire(void *dst, const void *src, int64_t count,
+                              int32_t wire_dtype);
+extern "C" int kf_decode_accumulate(void *acc, const void *src, int64_t count,
+                                    int32_t wire_dtype, int32_t op);
+
+namespace {
+constexpr int32_t F32 = 11, F16 = 9, BF16 = 10, SUM = 0;
+constexpr int64_t N = 1 << 18;     // one "bucket"
+constexpr int THREADS = 8;         // pool threads sharing it
+constexpr int ROUNDS = 16;
+
+int fail(const char *what) {
+  std::fprintf(stderr, "sanitizer_smoke: FAILED at %s\n", what);
+  return 1;
+}
+}  // namespace
+
+int main() {
+  const int32_t wires[] = {BF16, F16};
+  std::vector<float> src(N), dec(N), acc(N), red(N);
+  std::vector<uint16_t> wire(N);
+  for (int64_t i = 0; i < N; ++i) src[i] = (float)(i % 128) - 64.0f;
+
+  for (int round = 0; round < ROUNDS; ++round) {
+    const int32_t wd = wires[round % 2];
+    std::fill(acc.begin(), acc.end(), 1.0f);
+    std::vector<std::thread> ts;
+    ts.reserve(THREADS);
+    for (int t = 0; t < THREADS; ++t) {
+      ts.emplace_back([&, t, wd] {
+        // disjoint segment of the shared buffers, like a ring step
+        const int64_t sb = t * (N / THREADS);
+        const int64_t se = (t + 1) * (N / THREADS);
+        const int64_t n = se - sb;
+        if (kf_encode_wire(wire.data() + sb, src.data() + sb, n, wd))
+          std::exit(2);
+        if (kf_decode_wire(dec.data() + sb, wire.data() + sb, n, wd))
+          std::exit(2);
+        if (kf_decode_accumulate(acc.data() + sb, wire.data() + sb, n, wd,
+                                 SUM))
+          std::exit(2);
+        if (kf_transform2(red.data() + sb, dec.data() + sb, acc.data() + sb,
+                          n, F32, SUM))
+          std::exit(2);
+      });
+    }
+    for (auto &t : ts) t.join();
+    // every value in src is a small integer in [-64, 63], exactly
+    // representable in bf16 AND f16, so the codec must round-trip
+    // bit-exactly and the sums are exact
+    for (int64_t i = 0; i < N; i += 997) {
+      if (dec[i] != src[i]) return fail("decode round-trip");
+      if (acc[i] != src[i] + 1.0f) return fail("decode-accumulate");
+      if (red[i] != dec[i] + acc[i]) return fail("transform2");
+    }
+  }
+  std::puts("sanitizer_smoke: ok");
+  return 0;
+}

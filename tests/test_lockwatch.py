@@ -1,0 +1,298 @@
+"""Runtime lock-order detector (ISSUE 7): a constructed ABBA deadlock
+across two threads is reported as an audit event BEFORE anything hangs,
+long-held locks fire their warning, KF_DEBUG_LOCKS unset means the
+wrapper is never installed (zero overhead), and the instrumented
+proxies keep Condition/Event/RLock semantics intact — the detector must
+never change program behavior, only observe it.
+"""
+
+import os
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from kungfu_tpu.devtools import lockwatch
+from kungfu_tpu.telemetry import audit, metrics
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture
+def watched(monkeypatch):
+    monkeypatch.setenv("KF_DEBUG_LOCKS_HELD_MS", "40")
+    audit.clear()
+    lockwatch.install()
+    try:
+        yield lockwatch
+    finally:
+        lockwatch.uninstall()
+        audit.clear()
+
+
+def _violations():
+    assert lockwatch.flush(10), "reporter queue failed to drain"
+    return [r for r in audit.records() if r.kind == "lock_order_violation"]
+
+
+def _long_held():
+    assert lockwatch.flush(10), "reporter queue failed to drain"
+    return [r for r in audit.records() if r.kind == "lock_long_held"]
+
+
+def test_not_installed_by_default_zero_overhead():
+    # this pytest process imported kungfu_tpu without KF_DEBUG_LOCKS:
+    # threading.Lock must be the raw C factory, not our proxy
+    assert not lockwatch.installed() or threading.Lock is not lockwatch._REAL_LOCK
+    # subprocess proof: import the package with the knob unset and
+    # assert lockwatch was never even imported
+    env = dict(os.environ)
+    env.pop("KF_DEBUG_LOCKS", None)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c",
+         "import sys, threading; real = threading.Lock\n"
+         "import kungfu_tpu.api\n"
+         "assert threading.Lock is real, 'Lock replaced without the knob'\n"
+         "assert not any('lockwatch' in m for m in sys.modules), \\\n"
+         "    'lockwatch imported without the knob'\n"
+         "print('clean')"],
+        capture_output=True, text=True, env=env, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "clean" in r.stdout
+
+
+def test_install_wraps_and_uninstall_restores(watched):
+    lk = threading.Lock()
+    assert type(lk).__name__ == "_DebugLock"
+    rl = threading.RLock()
+    assert type(rl).__name__ == "_DebugRLock"
+    lockwatch.uninstall()
+    assert type(threading.Lock()).__module__ == "_thread"
+    # locks created while installed keep working after uninstall
+    with lk:
+        assert lk.locked()
+
+
+def test_abba_cycle_detected_before_hang(watched):
+    A = threading.Lock()
+    B = threading.Lock()
+    order = []
+
+    # the two threads run their nestings SEQUENTIALLY (t2 starts after
+    # t1 finished), so nothing ever blocks — the detector must flag the
+    # reversed ordering from the acquisition graph alone, which is
+    # exactly what "reported before it hangs" means
+    def t1():
+        with A:
+            with B:
+                order.append("t1")
+
+    def t2():
+        with B:
+            with A:
+                order.append("t2")
+
+    th = threading.Thread(target=t1, daemon=True)
+    th.start(); th.join(10)
+    assert not _violations(), "A->B alone is not a cycle"
+    th = threading.Thread(target=t2, daemon=True)
+    th.start(); th.join(10)
+    assert order == ["t1", "t2"]
+
+    v = _violations()
+    assert len(v) == 1, [r.detail for r in v]
+    d = v[0].detail
+    assert "->" in d["cycle"]
+    assert d["holding"] and d["wants"]
+    assert "test_lockwatch" in d["cycle"]
+    c = metrics.REGISTRY.counter(
+        "kungfu_debug_lock_order_violations_total",
+        "Findings of the KF_DEBUG_LOCKS runtime lock detector")
+    assert c.value >= 1
+
+
+def test_abba_under_real_contention_reports_without_deadlock(watched):
+    """The genuinely-deadlocking interleaving: t1 holds A and wants B
+    while t2 holds B and wants A. Bounded inner acquires let the threads
+    escape; the detector must still have reported the cycle at the
+    moment the reversed acquire was ATTEMPTED."""
+    A = threading.Lock()
+    B = threading.Lock()
+    t1_has_a = threading.Event()
+    t2_has_b = threading.Event()
+
+    def t1():
+        with A:
+            t1_has_a.set()
+            t2_has_b.wait(5)
+            B.acquire(timeout=0.5) and B.release()
+
+    def t2():
+        with B:
+            t2_has_b.set()
+            t1_has_a.wait(5)
+            A.acquire(timeout=0.5) and A.release()
+
+    ts = [threading.Thread(target=f, daemon=True) for f in (t1, t2)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(15)
+        assert not t.is_alive(), "bounded acquires cannot hang"
+    assert len(_violations()) == 1, [r.detail for r in _violations()]
+
+
+def test_three_lock_cycle_detected(watched):
+    A, B, C = threading.Lock(), threading.Lock(), threading.Lock()
+
+    def nest(outer, inner):
+        with outer:
+            with inner:
+                pass
+
+    for pair in ((A, B), (B, C), (C, A)):  # A->B->C->A
+        t = threading.Thread(target=nest, args=pair, daemon=True)
+        t.start(); t.join(10)
+    v = _violations()
+    assert len(v) == 1
+    assert v[0].detail["cycle"].count("->") >= 3
+
+
+def test_consistent_ordering_is_clean(watched):
+    A = threading.Lock()
+    B = threading.Lock()
+
+    def worker():
+        for _ in range(50):
+            with A:
+                with B:
+                    pass
+
+    ts = [threading.Thread(target=worker, daemon=True) for _ in range(4)]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(20)
+    assert not _violations()
+    assert lockwatch.edge_count() >= 1
+
+
+def test_long_held_lock_reported_once_per_site(watched):
+    L = threading.Lock()
+    for _ in range(3):
+        with L:
+            time.sleep(0.06)  # > the fixture's 40ms threshold
+    held = _long_held()
+    assert len(held) == 1, [r.detail for r in held]  # site-deduped
+    assert held[0].detail["held_ms"] >= 40
+    assert "test_lockwatch" in held[0].detail["lock"]
+    # the counter still counts every occurrence
+    c = metrics.REGISTRY.counter(
+        "kungfu_debug_lock_long_held_total",
+        "Findings of the KF_DEBUG_LOCKS runtime lock detector")
+    assert c.value >= 1
+
+
+def test_fast_holds_not_reported(watched):
+    L = threading.Lock()
+    for _ in range(100):
+        with L:
+            pass
+    assert not _long_held()
+
+
+def test_condition_event_rlock_semantics_survive(watched):
+    # Condition handoff
+    c = threading.Condition()
+    got = []
+
+    def waiter():
+        with c:
+            got.append(c.wait(5))
+
+    w = threading.Thread(target=waiter, daemon=True)
+    w.start()
+    time.sleep(0.05)
+    with c:
+        c.notify()
+    w.join(10)
+    assert got == [True]
+
+    # Event set/wait across threads
+    e = threading.Event()
+    threading.Thread(target=lambda: (time.sleep(0.02), e.set()),
+                     daemon=True).start()
+    assert e.wait(5)
+
+    # RLock reentrancy (no self-cycle, no stack corruption)
+    rl = threading.RLock()
+    with rl:
+        with rl:
+            with rl:
+                pass
+    assert not _violations()
+
+
+def test_condition_wait_does_not_count_as_long_held(watched):
+    # cond.wait() releases the lock via _release_save; the detector must
+    # pause the hold timer or a 200ms wait would be a false long-held
+    c = threading.Condition()
+
+    def waiter():
+        with c:
+            c.wait(0.2)
+
+    t = threading.Thread(target=waiter, daemon=True)
+    t.start()
+    t.join(10)
+    assert not _long_held(), [r.detail for r in _long_held()]
+
+
+def test_nonblocking_and_timeout_acquires(watched):
+    L = threading.Lock()
+    assert L.acquire(False)
+    assert not L.acquire(False)  # contended try-acquire: no bookkeeping leak
+    L.release()
+    assert L.acquire(timeout=0.1)
+    L.release()
+    assert not _violations()
+
+
+def test_gauge_publish(watched):
+    A, B = threading.Lock(), threading.Lock()
+    with A:
+        with B:
+            pass
+    lockwatch.publish_gauges()
+    g = metrics.REGISTRY.gauge(
+        "kungfu_debug_lock_sites",
+        "Lock creation sites in the lockwatch acquisition graph")
+    assert g.value >= 1
+
+
+def test_cross_thread_release_clears_holder_entry(watched):
+    # threading.Lock legally supports acquire-on-A / release-on-B
+    # (handoff/signaling). The release must clear A's held-entry: a
+    # stale one would emit a false `H -> X` ordering edge on every
+    # later acquire A makes, and repeated handoffs would grow A's
+    # stack without bound.
+    H = threading.Lock()
+    X = threading.Lock()
+    H.acquire()  # main thread holds H
+    t = threading.Thread(target=H.release, daemon=True)
+    t.start()
+    t.join(5)
+    assert not t.is_alive()
+    assert not H.locked()
+    before = lockwatch.edge_count()
+    with X:  # would record H -> X if the handoff left H "held" here
+        pass
+    assert lockwatch.edge_count() == before
+    # and the main thread's stack is actually empty, not just edge-less
+    assert not lockwatch._stacks.get(threading.get_ident())
+    assert not _violations()

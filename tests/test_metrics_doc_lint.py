@@ -1,69 +1,35 @@
-"""Docs lint (ISSUE 6 satellite): every `kungfu_*` metric family the
-code can register must appear in docs/telemetry.md — the metrics table
-is the operator's index, and an undocumented family is invisible to
-the person staring at a dashboard at 3am.
+"""Docs lint shim (ISSUE 7 satellite): the metric-family doc lint is
+now kfcheck rules KF600/KF601 (kungfu_tpu/devtools/kfcheck/rules.py) so
+one driver owns all project lint; this file keeps it in tier-1 under
+its historical name.
 
-The scan is lexical (string literals in kungfu_tpu/), so it also
-catches families registered lazily at call time, which a
-runtime-registry walk would miss until the right code path ran."""
+Policy unchanged since ISSUE 6: every `kungfu_*` metric family the code
+can register must appear in docs/telemetry.md (the operator's index),
+and table rows must not outlive the code that registered them. The scan
+is lexical (string literals in kungfu_tpu/), so families registered
+lazily at call time are covered too.
+"""
 
-import os
-import re
-
-REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-PKG = os.path.join(REPO, "kungfu_tpu")
-DOC = os.path.join(REPO, "docs", "telemetry.md")
-
-# full metric names only: prefixes under construction (e.g. the
-# "kungfu_process_" filter in flight snapshots) end with "_"
-NAME_RE = re.compile(r'"(kungfu_[a-z0-9_]+[a-z0-9])"')
+from kungfu_tpu.devtools.kfcheck import core
 
 
-def _source_metric_names():
-    names = set()
-    for dirpath, _, files in os.walk(PKG):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            with open(os.path.join(dirpath, fn), encoding="utf-8") as f:
-                names.update(NAME_RE.findall(f.read()))
-    return names
+def _run(rule):
+    core._ensure_rules_loaded()
+    return core.run_project(select=[rule])
 
 
 def test_every_metric_family_documented():
-    names = _source_metric_names()
-    # the scan must keep finding the registry (guard against a rename
-    # silently turning this lint into a no-op)
-    assert len(names) > 30, sorted(names)
-    with open(DOC, encoding="utf-8") as f:
-        doc = f.read()
-    missing = sorted(n for n in names if n not in doc)
-    assert not missing, (
+    findings = _run("KF600")
+    assert not findings, (
         "metric families registered in kungfu_tpu/ but absent from "
-        f"docs/telemetry.md: {missing} — add them to the metrics table"
+        "docs/telemetry.md — add them to the metrics table:\n  "
+        + "\n  ".join(f.render() for f in findings)
     )
 
 
 def test_doc_does_not_document_ghosts():
-    """Families named in the docs metrics TABLE must still exist in the
-    code (stale rows mislead operators as much as missing ones).
-    Derived exposition suffixes (_bucket/_sum/_count) and prose
-    references outside the table are out of scope."""
-    names = _source_metric_names()
-    # rate gauges are rendered by the net monitor's extra renderer, not
-    # registered via a string literal in one call site
-    names |= {"kungfu_egress_rate", "kungfu_ingress_rate"}
-    with open(DOC, encoding="utf-8") as f:
-        table_rows = [
-            l for l in f.read().splitlines()
-            if l.startswith("| `kungfu_")
-        ]
-    assert len(table_rows) > 20, "metrics table not found where expected"
-    ghosts = []
-    for row in table_rows:
-        for doc_name in re.findall(r"`(kungfu_[a-z0-9_]+)`", row.split("|")[1]):
-            if doc_name not in names:
-                ghosts.append(doc_name)
-    assert not ghosts, (
-        f"docs/telemetry.md documents metrics that no code registers: {ghosts}"
+    findings = _run("KF601")
+    assert not findings, (
+        "docs/telemetry.md documents metrics that no code registers:\n  "
+        + "\n  ".join(f.render() for f in findings)
     )

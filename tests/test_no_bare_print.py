@@ -1,59 +1,22 @@
-"""Lint (ISSUE 1 satellite): no bare print() calls under kungfu_tpu/.
+"""Lint shim (ISSUE 7 satellite): the bare-print ban is now kfcheck
+rule KF500 (kungfu_tpu/devtools/kfcheck/rules.py) so one driver owns
+all project lint; this file keeps the lint in tier-1 under its
+historical name and documents where the rule moved.
 
-Everything routes through kungfu_tpu.telemetry.log (leveled, rank-
-prefixed, structured) or log.echo() for CLI result lines. Exempt:
-runner/cli.py and info/ — user-facing CLIs whose stdout IS the product.
-
-AST-based (not grep) so docstrings and comments mentioning print() are
-not false positives.
+Policy unchanged since ISSUE 1: everything routes through
+kungfu_tpu.telemetry.log (leveled, rank-prefixed, structured) or
+log.echo() for CLI result lines; runner/cli.py and info/ are exempt —
+user-facing CLIs whose stdout IS the product.
 """
 
-import ast
-import os
-
-PKG = os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "kungfu_tpu"
-)
-
-EXEMPT = {
-    os.path.join("runner", "cli.py"),
-}
-EXEMPT_DIRS = {"info"}
-
-
-def _exempt(rel: str) -> bool:
-    if rel in EXEMPT:
-        return True
-    return rel.split(os.sep)[0] in EXEMPT_DIRS
-
-
-def _print_calls(path):
-    with open(path) as f:
-        tree = ast.parse(f.read(), filename=path)
-    out = []
-    for node in ast.walk(tree):
-        if (
-            isinstance(node, ast.Call)
-            and isinstance(node.func, ast.Name)
-            and node.func.id == "print"
-        ):
-            out.append(node.lineno)
-    return out
+from kungfu_tpu.devtools.kfcheck import core
 
 
 def test_no_bare_print_outside_cli_surfaces():
-    offenders = []
-    for root, _, files in os.walk(PKG):
-        for fn in files:
-            if not fn.endswith(".py"):
-                continue
-            path = os.path.join(root, fn)
-            rel = os.path.relpath(path, PKG)
-            if _exempt(rel):
-                continue
-            for lineno in _print_calls(path):
-                offenders.append(f"kungfu_tpu/{rel}:{lineno}")
-    assert not offenders, (
+    core._ensure_rules_loaded()
+    findings = core.run_project(select=["KF500"])
+    assert not findings, (
         "bare print() calls found (use kungfu_tpu.telemetry.log, or "
-        "log.echo() for CLI result lines):\n  " + "\n  ".join(offenders)
+        "log.echo() for CLI result lines):\n  "
+        + "\n  ".join(f.render() for f in findings)
     )
