@@ -15,6 +15,7 @@ from typing import Optional, Sequence
 import numpy as np
 
 from kungfu_tpu.base.ops import ReduceOp
+from kungfu_tpu.base.strategy import Strategy
 from kungfu_tpu.base.workspace import Workspace
 from kungfu_tpu.peer import finalize_default_peer, get_default_peer
 from kungfu_tpu.transport.message import ConnType as _ConnType
@@ -86,36 +87,128 @@ def group_all_reduce_arrays(
     steps — the reference's TF op outputs are graph-allocated once, and
     fresh 100 MB of np.empty per step costs real page-fault time."""
     flats = [np.ascontiguousarray(x).reshape(-1) for x in xs]
-    if outs is None:
-        outs = [np.empty_like(f) for f in flats]
-        flat_outs = outs
-    else:
-        if len(outs) != len(xs):
-            raise ValueError(f"outs mismatch: {len(outs)} != {len(xs)}")
-        for i, (o, f) in enumerate(zip(outs, flats)):
-            # reshape(-1) of a non-contiguous array is a COPY — the
-            # collective would fill the copy and the caller's buffer
-            # would silently keep last step's data
-            if not o.flags["C_CONTIGUOUS"]:
-                raise ValueError("outs arrays must be C-contiguous")
-            # size/dtype mismatches reach the native reduce as raw
-            # pointers: a short recv buffer is an out-of-bounds WRITE,
-            # a dtype mismatch reinterprets bytes — both must fail here
-            if o.size != f.size:
-                raise ValueError(
-                    f"outs[{i}] size {o.size} != input size {f.size}"
-                )
-            if o.dtype != f.dtype:
-                raise ValueError(
-                    f"outs[{i}] dtype {o.dtype} != input dtype {f.dtype}"
-                )
-        flat_outs = [o.reshape(-1) for o in outs]
+    flat_outs = _group_outs(xs, flats, outs)
     ws = [
         Workspace(send=f, recv=o, op=op, name=f"kungfu::user::{name}:{i}")
         for i, (f, o) in enumerate(zip(flats, flat_outs))
     ]
     get_default_peer().current_session().group_all_reduce(ws)
     return [o.reshape(x.shape) for o, x in zip(flat_outs, xs)]
+
+
+class AsyncGroupResult:
+    """Handle for one round of asynchronous group allreduce
+    (:func:`group_all_reduce_async`): ``wait()`` blocks until every
+    submitted tensor has been reduced and returns the results (the
+    ``outs`` buffers, reshaped). With the scheduler disabled
+    (``KF_CONFIG_ASYNC=off``) the group already ran synchronously
+    INSIDE the submitting call — results are complete before the handle
+    exists, ``wait()`` just returns them and ``timeout`` is moot — so
+    the submit-per-tensor + ``flush_async()`` pattern works identically
+    under either knob value (one code path, A/B by knob)."""
+
+    def __init__(self, sess, flat_outs, xs, round_index=None):
+        self._sess = sess
+        self._flat_outs = flat_outs
+        self._xs = xs
+        self._round = round_index  # scheduler round; None = sync fallback
+        self._done = round_index is None
+
+    def wait(self, timeout=None):
+        if not self._done:
+            # round-aware: several handles of the same round each call
+            # wait() (the documented per-tensor pattern) — only the
+            # first actually flushes; the rest see the round already
+            # advanced and return immediately
+            self._sess.scheduler().flush_round(self._round, timeout=timeout)
+            self._done = True
+        return [o.reshape(x.shape) for o, x in zip(self._flat_outs, self._xs)]
+
+
+def group_all_reduce_async(
+    xs, op: ReduceOp = ReduceOp.SUM, name: str = "group", outs=None
+) -> AsyncGroupResult:
+    """Asynchronous host-plane group allreduce (ISSUE 10): each array is
+    SUBMITTED to the session's background collective scheduler as soon
+    as this call sees it — buckets launch and walk while the caller
+    keeps computing (the backprop-overlap path) — and the returned
+    handle's ``wait()`` blocks only for the tail. Call once per tensor
+    as gradients become ready (1-element lists), or with the whole set.
+
+    Tensor identity: ``(name, index)`` must be stable across steps —
+    the first step's submission order is negotiated cluster-wide as the
+    launch order (consensus-checked), and every later step must submit
+    the same set (in any order). Results are bit-identical to
+    :func:`group_all_reduce_arrays` on the same inputs. Pass ``outs``
+    to reuse result buffers across steps like the sync API."""
+    flats = [np.ascontiguousarray(x).reshape(-1) for x in xs]
+    flat_outs = _group_outs(xs, flats, outs)
+    sess = get_default_peer().current_session()
+    if not sess.async_enabled():
+        # synchronous fallback, executed EAGERLY: callers following the
+        # submit + flush_async() pattern never touch the handle, so a
+        # deferred group would silently not run. Name notes: unlike the
+        # scheduler path (stable names, scheduler-stamped rounds), each
+        # call needs its OWN wire names — a fast peer's step k+1 sends
+        # must never be consumed by a slower peer still receiving step
+        # k. Peers call in identical program order, so the process-
+        # local sequence agrees.
+        with _async_seq_lock:
+            seq = _async_seq[0]
+            _async_seq[0] += 1
+        ws = [
+            Workspace(send=f, recv=o, op=op,
+                      name=f"kungfu::user::async:{name}:{i}@{seq}")
+            for i, (f, o) in enumerate(zip(flats, flat_outs))
+        ]
+        sess.group_all_reduce(ws)
+        return AsyncGroupResult(sess, flat_outs, xs)
+    sched = sess.scheduler()
+    ws = [
+        Workspace(send=f, recv=o, op=op, name=f"kungfu::user::async:{name}:{i}")
+        for i, (f, o) in enumerate(zip(flats, flat_outs))
+    ]
+    for w in ws:
+        sched.submit(w)
+    return AsyncGroupResult(sess, flat_outs, xs, round_index=sched.round_index())
+
+
+def flush_async(timeout=None) -> None:
+    """End the current async round: block until every workspace
+    submitted to the session's scheduler has completed (no-op when the
+    scheduler is off, unused this epoch, or the round is empty — a
+    defensive flush never freezes an empty registration). The per-round
+    barrier of the submission API — call once per training step."""
+    sess = get_default_peer().current_session()
+    if sess.async_enabled():
+        sess.scheduler().flush(timeout=timeout)
+
+
+_async_seq = [0]
+_async_seq_lock = threading.Lock()
+
+
+def _group_outs(xs, flats, outs):
+    """Shared outs validation of the group allreduce APIs: C-contiguous,
+    size- and dtype-matched — mismatches reach the native reduce as raw
+    pointers, so they must fail here, not corrupt memory there."""
+    if outs is None:
+        return [np.empty_like(f) for f in flats]
+    if len(outs) != len(xs):
+        raise ValueError(f"outs mismatch: {len(outs)} != {len(xs)}")
+    for i, (o, f) in enumerate(zip(outs, flats)):
+        # reshape(-1) of a non-contiguous array is a COPY — the
+        # collective would fill the copy and the caller's buffer
+        # would silently keep last step's data
+        if not o.flags["C_CONTIGUOUS"]:
+            raise ValueError("outs arrays must be C-contiguous")
+        if o.size != f.size:
+            raise ValueError(f"outs[{i}] size {o.size} != input size {f.size}")
+        if o.dtype != f.dtype:
+            raise ValueError(
+                f"outs[{i}] dtype {o.dtype} != input dtype {f.dtype}"
+            )
+    return [o.reshape(-1) for o in outs]
 
 
 def broadcast_array(x: np.ndarray, root: int = 0, name: str = "user") -> np.ndarray:
@@ -241,18 +334,22 @@ def check_interference() -> bool:
     return get_default_peer().current_session().check_interference()
 
 
-def active_strategy() -> str:
-    """Name of the running adaptive candidate: the strategy, suffixed
-    with "/<codec>" when a wire codec is active (candidates are
+def active_strategy() -> "Optional[Strategy]":
+    """The running adaptive candidate's Strategy (the enum), or None
+    under a set_tree override. ISSUE 10 satellite: this used to return
+    the codec-qualified display string while its callers expected the
+    Strategy — the string contract now lives in its own accessor,
+    :func:`active_candidate`."""
+    return get_default_peer().current_session().active_strategy()
+
+
+def active_candidate() -> str:
+    """Display name of the running adaptive candidate: the strategy,
+    suffixed with "/<codec>" when a wire codec is active (candidates are
     (strategy, codec) pairs — an interference vote may have toggled
     compression rather than the graphs); "SET_TREE" under a set_tree
     override."""
-    sess = get_default_peer().current_session()
-    s = sess.active_strategy()
-    if s is None:
-        return "SET_TREE"
-    wire = sess._active_wire_mode()
-    return s.name if wire == "off" else f"{s.name}/{wire}"
+    return get_default_peer().current_session().active_candidate_name()
 
 
 def calc_stats() -> dict:

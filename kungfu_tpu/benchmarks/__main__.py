@@ -143,6 +143,124 @@ def bench_host_wire_ab(model: str, iters: int, warmup: int = 4) -> None:
             )
 
 
+def _simulated_backprop(grads, scratch, passes: int = 16) -> None:
+    """Deterministic per-tensor FLOP load standing in for backward-pass
+    compute (the bench has no real model). 16 passes of elementwise
+    work per parameter is a LOW bound on a real backward pass's
+    FLOP-to-gradient-bytes ratio (a conv/matmul backward touches each
+    weight far more than 16 times), so the overlap this measures is the
+    conservative end of what a real step offers the scheduler. Both
+    legs pay the identical load, so the A/B ratio stays drift-free, and
+    it never mutates the gradients — the bit-identity claim depends on
+    both legs reducing the same bytes."""
+    for g, s in zip(grads, scratch):
+        for _ in range(passes):
+            np.multiply(g, np.float32(1.0000001), out=s)
+
+
+def bench_host_async_ab(model: str, iters: int, warmup: int = 4) -> None:
+    """Paired same-process async-scheduler A/B (ISSUE 10): the SYNC leg
+    runs the serial step loop — simulate every tensor's backward
+    compute, then one step-end `group_all_reduce_arrays` — while the
+    ASYNC leg submits each tensor to the background scheduler the moment
+    its compute finishes (readiness order: last layer first, like real
+    backprop) and only flushes the tail. Legs interleave in alternating
+    rounds within one process/session, so box drift cancels out of the
+    ratio exactly like --wire-ab. The OVERLAP line reports the measured
+    flush-wait vs engine-busy time — flush-wait ≪ walk time is the
+    overlap actually happening, not inferred."""
+    from kungfu_tpu import api
+    from kungfu_tpu.models.fake import fake_gradients
+    from kungfu_tpu.peer import get_default_peer
+
+    grads = fake_gradients(model)
+    outs = [np.empty_like(g) for g in grads]
+    scratch = [np.empty_like(g) for g in grads]
+    total_bytes = sum(g.nbytes for g in grads)
+    sess = get_default_peer().current_session()
+    if not sess.async_enabled():
+        raise SystemExit(
+            "--async A/B needs the scheduler: KF_CONFIG_ASYNC=on|auto "
+            "must reach every worker before the session comes up (the "
+            "--async flag sets it process-wide; under kfrun use "
+            "KF_BENCH_ASYNC with the bench agent)"
+        )
+    sched = sess.scheduler()
+    n = len(grads)
+    legs: dict = {"sync": [], "async": []}
+    rounds = 8  # 4 alternating rounds per mode
+    # unlike --wire-ab, allow per=1: the async A/B pays a simulated
+    # backward per sample, so bert-size sets at 16 steps blow through
+    # any reasonable harness timeout — --iters controls the budget
+    per = max(1, iters // 4)
+
+    def run_sync(tag: str) -> None:
+        _simulated_backprop(grads, scratch)
+        api.group_all_reduce_arrays(grads, name=tag, outs=outs)
+
+    def run_async() -> None:
+        # readiness order: reversed (the last layer's gradient exists
+        # first); registration pins the launch order from round one, so
+        # every peer walks identical bucket sequences regardless
+        for i in reversed(range(n)):
+            _simulated_backprop(grads[i : i + 1], scratch[i : i + 1])
+            api.group_all_reduce_async(
+                [grads[i]], name=f"b{i}", outs=[outs[i]]
+            )
+        api.flush_async()
+
+    api.run_barrier()
+    for i in range(warmup):
+        run_sync(f"wu:{i}")
+    run_async()  # registration round + async staging warmup
+    api.run_barrier()
+    stats0 = sched.stats()
+    for rnd in range(rounds):
+        mode = "sync" if rnd % 2 == 0 else "async"
+        samples = legs[mode]
+        for it in range(per):
+            t0 = time.perf_counter()
+            if mode == "sync":
+                # per-iteration names: a fast worker's next-iteration
+                # sends must not be consumed by a slow worker still in
+                # this one (same reason as --wire-ab's ab:{rnd}:{i})
+                run_sync(f"ab:{rnd}:{it}")
+            else:
+                run_async()
+            samples.append(
+                total_bytes / (time.perf_counter() - t0) / (1 << 30)
+            )
+        api.run_barrier()
+    stats1 = sched.stats()
+    if api.current_rank() != 0:
+        return
+    meds = {m: float(np.median(s)) for m, s in legs.items()}
+    for m, s in legs.items():
+        log.echo(
+            f"RESULT: {float(np.mean(s)):.3f} "
+            f"+-{float(1.96 * np.std(s)):.3f} (GiB/s) "
+            f"median {meds[m]:.3f} [HOST-AB async={m}, "
+            f"x{api.cluster_size()} workers, {model}, "
+            f"{len(s)} interleaved samples]"
+        )
+    log.echo(
+        f"RESULT: async / sync median speedup: "
+        f"{meds['async'] / meds['sync']:.2f}x [interleaved paired, "
+        f"{model}, simulated backprop]"
+    )
+    a_rounds = max(1, stats1["rounds"] - stats0["rounds"])
+    flush_wait = (stats1["flush_wait_s"] - stats0["flush_wait_s"]) / a_rounds
+    busy = (stats1["busy_s"] - stats0["busy_s"]) / a_rounds
+    overlap = (stats1["overlap_s"] - stats0["overlap_s"]) / a_rounds
+    frac = overlap / busy if busy > 0 else 0.0
+    ratio = flush_wait / busy if busy > 0 else float("inf")
+    log.echo(
+        f"OVERLAP {model}: flush-wait {flush_wait * 1e3:.1f} ms vs walk "
+        f"{busy * 1e3:.1f} ms per step — {frac:.0%} of engine time "
+        f"overlapped with backprop (flush-wait/walk {ratio:.2f})"
+    )
+
+
 def bench_host(model: str, iters: int, warmup: int = 4) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
@@ -336,11 +454,24 @@ def main() -> None:
         "(the adaptive mechanism), run --iters again, report both "
         "medians and the drift-free speedup ratio",
     )
+    p.add_argument(
+        "--async", action="store_true", dest="async_ab",
+        help="HOST only: paired same-process async-scheduler A/B — "
+        "alternate the serial step loop (compute all, then one step-end "
+        "group allreduce) with readiness-ordered submission to the "
+        "background scheduler (KF_CONFIG_ASYNC=on, set before the "
+        "session comes up), report both medians, the drift-free speedup "
+        "and the OVERLAP line (flush-wait vs walk time)",
+    )
     args = p.parse_args()
-    if args.method != "HOST" and (args.algo or args.wire or args.wire_ab):
+    if args.method != "HOST" and (
+        args.algo or args.wire or args.wire_ab or args.async_ab
+    ):
         # the default method is XLA: silently measuring the wrong plane
         # is worse than an error
-        p.error("--algo/--wire/--wire-ab only apply to --method HOST")
+        p.error("--algo/--wire/--wire-ab/--async only apply to --method HOST")
+    if args.wire_ab and args.async_ab:
+        p.error("--wire-ab and --async are separate A/Bs — pick one")
     if args.method == "HOST":
         import os
 
@@ -348,6 +479,8 @@ def main() -> None:
             os.environ["KF_CONFIG_ALGO"] = args.algo
         if args.wire:
             os.environ["KF_CONFIG_WIRE"] = args.wire
+        if args.async_ab:
+            os.environ["KF_CONFIG_ASYNC"] = "on"
         # wire-byte accounting rides the metrics gate; the bench wants it
         # on regardless so the A/B always reports bytes per peer
         from kungfu_tpu.telemetry import config as tconfig
@@ -361,6 +494,8 @@ def main() -> None:
         bench_gns(args.iters)
     elif args.wire_ab:
         bench_host_wire_ab(args.model, args.iters)
+    elif args.async_ab:
+        bench_host_async_ab(args.model, args.iters)
     else:
         bench_host(args.model, args.iters)
 

@@ -1,0 +1,134 @@
+"""Wire-codec layer of the host collective engine (ISSUE 5), factored
+out of host_session.py (ISSUE 10 prerequisite refactor).
+
+Owns everything codec-*policy*: the KF_CONFIG_WIRE mode table, the
+per-workspace compress-or-bypass decision (:class:`WireCodec` mixin on
+:class:`~kungfu_tpu.collective.host_session.HostSession`) and the
+deferred-decode handle the fused pipeline uses to merge the walk-end
+decode into bucket unpack. The codec *mechanics* (encode/decode/
+decode-accumulate kernels) stay in base/ops.py + native/reduce.cpp.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import numpy as np
+
+from kungfu_tpu import knobs
+from kungfu_tpu.base.dtype import DType
+from kungfu_tpu.base.ops import decode_wire
+from kungfu_tpu.base.workspace import Workspace
+from kungfu_tpu.utils.pool import get_buffer_pool
+
+# Wire codec (ISSUE 5 tentpole): f32 allreduce payloads travel the
+# transport as bf16/f16 while every reduce step accumulates into the f32
+# buffer. Like KF_CONFIG_ALGO this is a cluster-agreed runtime knob (it
+# decides message SIZES, so a disagreeing peer would read short/long
+# frames) — fail-fast enforced by check_knob_consensus at session start.
+# `auto` currently resolves to bf16 for eligible payloads (the TPU-native
+# format: f32-identical exponent range, so no overflow surprises); it is
+# a distinct mode so later heuristics (payload- or link-aware) can slot
+# in without an env change.
+WIRE_MODES = ("off", "bf16", "f16", "auto")
+
+WIRE_DTYPE = {"bf16": DType.BF16, "f16": DType.F16, "auto": DType.BF16}
+
+
+def wire_override() -> str:
+    """Parse KF_CONFIG_WIRE (read per session epoch, not import time).
+    The registry's strict choice parser raises on a typo and resolves
+    unset/empty to "off"."""
+    return knobs.get("KF_CONFIG_WIRE")
+
+
+class DeferredDecode:
+    """Handle to a compressed segmented walk's all-gather wire buffer,
+    returned instead of the walk-end f32 decode when the caller asked to
+    defer it (`_allreduce_ws(defer_decode=True)`). The fused pipeline's
+    unpacker decodes straight from this buffer into each member's recv —
+    fusing decode with unpack saves one full f32 pass over the bucket on
+    the hot path. Call `decode_into(dst, begin, end)` per member, then
+    `close()` exactly once to return the buffer to the pool."""
+
+    __slots__ = ("wire", "_buf", "_arr")
+
+    def __init__(self, wire: DType, buf, arr: np.ndarray):
+        self.wire = wire
+        self._buf = buf
+        self._arr = arr
+
+    def decode_into(self, dst: np.ndarray, begin: int, end: int) -> None:
+        seg = self._arr[begin:end]
+        if dst.flags["C_CONTIGUOUS"]:
+            decode_wire(dst, seg, self.wire)
+        else:
+            tmp = np.empty(end - begin, np.float32)
+            decode_wire(tmp, seg, self.wire)
+            np.copyto(dst, tmp)
+
+    def close(self) -> None:
+        if self._buf is not None:
+            get_buffer_pool().put(self._buf)
+            self._buf = None
+
+
+class WireCodec:
+    """Codec-policy mixin for HostSession: resolves the RUNNING wire
+    mode (config + lockstep adaptive votes) and decides per workspace
+    whether a walk compresses or bypasses. Relies on session state
+    (`wire_mode`, `_candidates`, `adaptive`, `_tree_override`,
+    `WIRE_MIN_BYTES`) owned by the facade's constructor."""
+
+    # Codec floor: encoding pays two passes (encode + decode) to halve
+    # the wire bytes, which only wins once the payload dwarfs the fixed
+    # per-walk costs; tiny control collectives also stay exact this way.
+    # Cluster-agreed like SEGMENT_MIN_BYTES (it decides message sizes).
+    WIRE_MIN_BYTES = int(knobs.get("KF_CONFIG_WIRE_MIN_BYTES"))
+
+    def _active_wire_mode(self) -> str:
+        """The RUNNING codec mode: the active adaptive candidate's wire
+        member, or the configured mode under a set_tree override (an
+        explicit forest replaces the graphs, not the codec)."""
+        if self._tree_override:
+            return self.wire_mode
+        return self._candidates[self.adaptive.active][1]
+
+    def _codec_bypass(self, reason: str, w: Workspace) -> None:
+        """Audit (once per (reason, dtype) per session epoch) that a
+        workspace bypassed an enabled codec — exact semantics preserved
+        for consensus lanes, variance probes and tiny residuals."""
+        key = (reason, w.send.dtype.str)
+        if key in self._codec_bypass_seen:
+            return
+        self._codec_bypass_seen.add(key)
+        from kungfu_tpu.telemetry import audit as _audit
+
+        _audit.record_event(
+            "wire_codec_bypass",
+            peer=str(self.self_id),
+            reason=reason,
+            dtype=w.send.dtype.str,
+            name=w.name,
+            nbytes=int(w.recv.nbytes),
+        )
+
+    def _wire_codec_for(self, w: Workspace) -> Optional[DType]:
+        """Codec decision for one allreduce workspace, or None (raw).
+
+        MUST depend only on cluster-agreed inputs — the resolved wire
+        mode (env + lockstep adaptive votes) and workspace properties
+        identical on every peer — because it decides the byte count of
+        every message in the walk. Non-f32 payloads (consensus lanes,
+        int gradients) and sub-WIRE_MIN_BYTES residuals bypass with an
+        audit event, never an error."""
+        mode = self._active_wire_mode()
+        if mode == "off":
+            return None
+        if w.send.dtype != np.float32:
+            self._codec_bypass("non_f32", w)
+            return None
+        if w.recv.nbytes < self.WIRE_MIN_BYTES:
+            self._codec_bypass("below_min_bytes", w)
+            return None
+        return WIRE_DTYPE[mode]

@@ -1,4 +1,4 @@
-"""Host-side collective engine: graph-walk collectives over the transport.
+"""Host-side collective engine facade: one session per cluster epoch.
 
 Capability parity: srcs/go/kungfu/session/session.go — an immutable
 peer-list epoch running Barrier / Consensus / Reduce / Broadcast / Gather /
@@ -11,435 +11,71 @@ collectives (consensus on cluster configs, barriers, progress sync) and for
 CPU-only test clusters — the device data plane is XLA over ICI
 (kungfu_tpu.ops). It is the direct replacement for the reference's
 rchannel data plane.
+
+Layering (ISSUE 10 refactor — this file is the facade, the engine lives
+in sibling modules so the async scheduler composes instead of accretes):
+
+- walks.py     — the walk engines (segmented ring, chunked graph walks)
+  and shared receive/accounting plumbing (:class:`WalkEngine` mixin);
+- codec.py     — wire-format policy: compress-or-bypass decisions,
+  deferred decode (:class:`WireCodec` mixin);
+- pipeline.py  — group fusion: deterministic bucketing and the 3-stage
+  pack/walk/unpack pipeline (:class:`GroupFusion` mixin);
+- profiler.py  — the process-global critical-path profiler and span
+  sampler;
+- scheduler.py — the async collective scheduler (per-session, lazily
+  created; drives the same pack/walk/unpack stages by readiness order).
+
+HostSession owns the per-epoch STATE (peers, strategies, adaptive
+candidates, metric handles) and the public collective API; the mixins
+own the mechanics.
 """
 
 from __future__ import annotations
 
-import os
-import queue
 import threading
 import time
-from typing import Callable, Dict, List, Optional, Sequence, Tuple
+from typing import Callable, Dict, List, Optional, Sequence, Tuple, TYPE_CHECKING
 
 import numpy as np
 
-from kungfu_tpu.base.dtype import DType
-from kungfu_tpu.base.ops import (
-    ReduceOp,
-    copy_segment,
-    decode_accumulate,
-    decode_wire,
-    encode_wire,
-    reduce_inplace,
-    reduce_segment,
-    transform_n,
-)
-from kungfu_tpu.telemetry import config as tconfig
-from kungfu_tpu.telemetry import link as tlink
-from kungfu_tpu.telemetry import metrics as tmetrics
 from kungfu_tpu import knobs
-from kungfu_tpu.utils import trace
+from kungfu_tpu.base.ops import ReduceOp
 from kungfu_tpu.base.strategy import Strategy
-from kungfu_tpu.collective.adaptive import AdaptiveState
-from kungfu_tpu.base.workspace import Workspace, even_partition
+from kungfu_tpu.base.workspace import Workspace
 from kungfu_tpu.collective import strategies as st
-from kungfu_tpu.collective.strategies import effective_cpu_count
+from kungfu_tpu.collective.adaptive import AdaptiveState
+from kungfu_tpu.collective.codec import WireCodec, wire_override
+from kungfu_tpu.collective.pipeline import GroupFusion
+from kungfu_tpu.collective.profiler import (  # noqa: F401 - back-compat re-exports
+    SpanSampler,
+    SpanSampler as _SpanSampler,
+    WalkProfiler,
+    get_walk_profiler,
+)
+from kungfu_tpu.collective.walks import (  # noqa: F401 - back-compat re-exports
+    CHUNK_BYTES,
+    DEFAULT_TIMEOUT,
+    WalkEngine,
+    algo_override,
+    choose_chunk_bytes,
+    _buf,
+)
 from kungfu_tpu.plan import topology as topo
 from kungfu_tpu.plan.graph import Graph
 from kungfu_tpu.plan.peer import PeerID, PeerList
+from kungfu_tpu.telemetry import config as tconfig
+from kungfu_tpu.telemetry import link as tlink
+from kungfu_tpu.telemetry import metrics as tmetrics
 from kungfu_tpu.transport.client import Client
 from kungfu_tpu.transport.handlers import CollectiveEndpoint
-from kungfu_tpu.transport.message import ConnType, Flags
-from kungfu_tpu.utils.pool import get_buffer_pool, get_pool
+from kungfu_tpu.transport.message import ConnType
+from kungfu_tpu.utils import trace
+from kungfu_tpu.utils.handoff import parallel_run as _par
 from kungfu_tpu.utils.stall import stall_detect
 
-# Chunking (parity: session.go chunkSize, but self-tuned): the optimal
-# trades chunk-walk overhead (fewer, bigger chunks) against striping/
-# pipelining (more, smaller chunks) and depends on host core count —
-# concurrent chunk walks only pay when cores exist to run them; on a
-# 1-core host every extra in-flight chunk is pure context-switch cost.
-# KF_CONFIG_CHUNK_BYTES overrides the heuristic.
-CHUNK_BYTES = int(knobs.get("KF_CONFIG_CHUNK_BYTES"))
-_CHUNK_MIN = 1 << 20
-_CHUNK_MAX = 32 << 20
-DEFAULT_TIMEOUT = 120.0
-
-# A/B algorithm override (benchmarks, operators): forces the engine onto
-# one family regardless of the configured/AUTO strategy. Like every other
-# engine knob it MUST agree cluster-wide (peers that resolved different
-# algorithms would wait on each other's rendezvous names forever).
-_ALGO_STRATEGY = {
-    "": None,
-    "auto": Strategy.AUTO,
-    "tree": Strategy.BINARY_TREE,
-    "segmented": Strategy.RING_SEGMENTED,
-}
-
-
-def algo_override() -> Optional[Strategy]:
-    """Parse KF_CONFIG_ALGO (read per session epoch, not import time).
-    The registry's strict choice parser raises on a typo — fail fast,
-    not silently diverge the cluster."""
-    return _ALGO_STRATEGY[knobs.get("KF_CONFIG_ALGO")]
-
-
-# Wire codec (ISSUE 5 tentpole): f32 allreduce payloads travel the
-# transport as bf16/f16 while every reduce step accumulates into the f32
-# buffer. Like KF_CONFIG_ALGO this is a cluster-agreed runtime knob (it
-# decides message SIZES, so a disagreeing peer would read short/long
-# frames) — fail-fast enforced by check_knob_consensus at session start.
-# `auto` currently resolves to bf16 for eligible payloads (the TPU-native
-# format: f32-identical exponent range, so no overflow surprises); it is
-# a distinct mode so later heuristics (payload- or link-aware) can slot
-# in without an env change.
-_WIRE_MODES = ("off", "bf16", "f16", "auto")
-
-_WIRE_DTYPE = {"bf16": DType.BF16, "f16": DType.F16, "auto": DType.BF16}
-
-
-def wire_override() -> str:
-    """Parse KF_CONFIG_WIRE (read per session epoch, not import time).
-    The registry's strict choice parser raises on a typo and resolves
-    unset/empty to "off"."""
-    return knobs.get("KF_CONFIG_WIRE")
-
-
-def choose_chunk_bytes(total: int) -> int:
-    """Chunk size for a `total`-byte collective: honour the env override,
-    else ~8 chunks per collective, clamped to [1 MiB, 32 MiB].
-
-    MUST depend only on cluster-agreed inputs (the workspace size): chunk
-    workspaces are named '<name>[i/k]', so peers that computed different
-    k would wait forever on each other's chunk names. That rules out
-    os.cpu_count() here (heterogeneous hosts); measured on the 1-core
-    box, 8 in-flight walks of >=1 MiB is within noise of the per-core
-    optimum anyway."""
-    if CHUNK_BYTES > 0:
-        return CHUNK_BYTES
-    c = total // 8
-    return max(_CHUNK_MIN, min(_CHUNK_MAX, c))
-
-
-def _par(
-    fns: List[Callable[[], None]],
-    timeout: float,
-    cancel: Optional[threading.Event] = None,
-) -> None:
-    """Run callables on the shared cached-thread pool, wait for all,
-    re-raise the first error (goroutine-style fan-out; an unbounded cached
-    pool avoids both thread-spawn cost per call and pool-exhaustion
-    deadlocks on nested parallelism).
-
-    All waits share ONE deadline (worst case = timeout, not
-    len(fns)*timeout). On timeout `cancel` is set before raising so
-    abandoned workers that later complete a recv can observe it and must
-    NOT mutate the caller's workspace (a reused recv buffer would be
-    corrupted by a late write)."""
-    if not fns:
-        return
-    if len(fns) == 1:
-        fns[0]()
-        return
-    cond = threading.Condition()
-    state = {"done": 0}
-    errs: List[BaseException] = []
-
-    def run(fn):
-        err: Optional[BaseException] = None
-        try:
-            fn()
-        except BaseException as e:  # noqa: BLE001 - propagated below
-            err = e
-        with cond:
-            state["done"] += 1
-            if err is not None:
-                errs.append(err)
-            cond.notify_all()
-
-    pool = get_pool()
-    for fn in fns:
-        pool.submit(lambda f=fn: run(f))
-    with cond:
-        if not cond.wait_for(lambda: state["done"] >= len(fns), timeout):
-            if cancel is not None:
-                cancel.set()
-            raise TimeoutError("collective thread timed out")
-        if errs:
-            raise errs[0]
-
-
-def _buf(arr: np.ndarray):
-    """Zero-copy byte view of a contiguous array (tobytes() fallback)."""
-    try:
-        return arr.data.cast("B")
-    except (ValueError, TypeError, AttributeError):
-        return arr.tobytes()
-
-
-class _DeferredDecode:
-    """Handle to a compressed segmented walk's all-gather wire buffer,
-    returned instead of the walk-end f32 decode when the caller asked to
-    defer it (`_allreduce_ws(defer_decode=True)`). The fused pipeline's
-    unpacker decodes straight from this buffer into each member's recv —
-    fusing decode with unpack saves one full f32 pass over the bucket on
-    the hot path. Call `decode_into(dst, begin, end)` per member, then
-    `close()` exactly once to return the buffer to the pool."""
-
-    __slots__ = ("wire", "_buf", "_arr")
-
-    def __init__(self, wire: DType, buf, arr: np.ndarray):
-        self.wire = wire
-        self._buf = buf
-        self._arr = arr
-
-    def decode_into(self, dst: np.ndarray, begin: int, end: int) -> None:
-        seg = self._arr[begin:end]
-        if dst.flags["C_CONTIGUOUS"]:
-            decode_wire(dst, seg, self.wire)
-        else:
-            tmp = np.empty(end - begin, np.float32)
-            decode_wire(tmp, seg, self.wire)
-            np.copyto(dst, tmp)
-
-    def close(self) -> None:
-        if self._buf is not None:
-            get_buffer_pool().put(self._buf)
-            self._buf = None
-
-
-class _WalkProfile:
-    """Per-walk critical-path accumulator (one walk = one thread running
-    one segmented ring or one chunk's graph pair): seconds the walk
-    thread spent blocked on receives and blocked on sends. Everything
-    else — reduce/codec kernels, pack/unpack memcpys, Python overhead —
-    is compute by construction (wall − wait − send), so the three
-    fractions always sum to 1."""
-
-    __slots__ = ("wait", "send")
-
-    def __init__(self):
-        self.wait = 0.0
-        self.send = 0.0
-
-
-class _SpanSampler:
-    """Deterministic walk sampler for per-step spans
-    (KF_TELEMETRY_SPAN_SAMPLE): emits per-step spans for walk n iff the
-    integer part of n*rate advances — exactly rate*N of any N walks,
-    evenly spaced, identical across reruns (no RNG)."""
-
-    __slots__ = ("rate", "_n", "_lock")
-
-    def __init__(self, rate: float):
-        self.rate = rate
-        self._n = 0
-        self._lock = threading.Lock()
-
-    def sample(self) -> bool:
-        if self.rate >= 1.0:
-            return True
-        if self.rate <= 0.0:
-            return False
-        with self._lock:
-            self._n += 1
-            n = self._n
-        return int(n * self.rate) != int((n - 1) * self.rate)
-
-
-class WalkProfiler:
-    """Collective critical-path profiler (ISSUE 6 tentpole, part b).
-
-    Aggregates every allreduce walk's wall-time attribution per
-    (public collective, executing strategy): fractions of walk time
-    spent wait-on-recv vs reduce/codec compute vs send-blocked, the
-    achieved throughput against the 2·(k−1)/k·N bandwidth-optimal
-    bound, and — when the link plane has a bandwidth estimate for the
-    links the walk used — an **efficiency ratio**:
-
-        efficiency = (2·(k−1)/k·N / link_bw) / wall
-                   = optimal transfer time / achieved wall time
-
-    1.0 means the walk moved its optimal byte volume at full measured
-    link speed; the gap to 1.0 is the overhead the async scheduler and
-    topology re-planner (ROADMAP items 2/5) have to harvest. Exported
-    as ``kungfu_collective_efficiency_ratio`` gauges and
-    ``kungfu_collective_walk_seconds_total{phase}`` counters; process-
-    global (sessions are rebuilt every elastic epoch, the attribution
-    series must survive them).
-
-    Attribution caveats (documented, not bugs): on graph walks the
-    pairwise receive path folds its in-place reduce into the timed
-    receive block (the n-ary fan-in path separates them), and wire-mode
-    fan-out encodes land in compute while the transport part of the
-    fan-out lands in send. The fractions describe the walk *thread*;
-    pool-thread work overlapped with a timed block is deliberately not
-    double-counted.
-    """
-
-    _ALPHA = 0.2  # EWMA for the efficiency series, matches the link plane
-
-    def __init__(self):
-        self._lock = threading.Lock()
-        self._acc: Dict[Tuple[str, str], dict] = {}
-
-    def record(
-        self,
-        collective: str,
-        strategy: str,
-        k: int,
-        payload_bytes: int,
-        wall: float,
-        wait: float,
-        send: float,
-        link_bw: Optional[float] = None,
-    ) -> None:
-        if wall <= 0.0 or k < 2 or payload_bytes <= 0:
-            return
-        # clamp measurement jitter so per-walk phases never exceed wall
-        # (fractions must sum to 1 by construction)
-        blocked = wait + send
-        if blocked > wall:
-            scale = wall / blocked
-            wait *= scale
-            send *= scale
-        opt_bytes = 2.0 * (k - 1) / k * payload_bytes
-        eff = None
-        if link_bw is not None and link_bw > 0:
-            eff = (opt_bytes / link_bw) / wall
-        key = (collective, strategy)
-        with self._lock:
-            a = self._acc.get(key)
-            if a is None:
-                a = self._acc[key] = {
-                    "walks": 0, "wall": 0.0, "wait": 0.0, "send": 0.0,
-                    "payload_bytes": 0.0, "opt_bytes": 0.0,
-                    "eff": None, "eff_samples": 0,
-                    # EWMAs of RECENT walks, for signals(): the cumulative
-                    # sums above describe the whole run (snapshot/bench),
-                    # but an adaptation signal weighted by all-time sums
-                    # goes inert after hours — a link that degrades at
-                    # walk 50,000 must move the signal within ~10 walks,
-                    # like the link plane's own bandwidth EWMA does
-                    "wait_frac_ewma": None, "wall_ewma": None,
-                }
-            a["walks"] += 1
-            a["wall"] += wall
-            a["wait"] += wait
-            a["send"] += send
-            a["payload_bytes"] += payload_bytes
-            a["opt_bytes"] += opt_bytes
-            wf = wait / wall
-            a["wait_frac_ewma"] = (
-                wf if a["wait_frac_ewma"] is None
-                else self._ALPHA * wf + (1.0 - self._ALPHA) * a["wait_frac_ewma"]
-            )
-            a["wall_ewma"] = (
-                wall if a["wall_ewma"] is None
-                else self._ALPHA * wall + (1.0 - self._ALPHA) * a["wall_ewma"]
-            )
-            if eff is not None:
-                a["eff"] = (
-                    eff if a["eff"] is None
-                    else self._ALPHA * eff + (1.0 - self._ALPHA) * a["eff"]
-                )
-                a["eff_samples"] += 1
-                ewma = a["eff"]
-            else:
-                ewma = None
-        self._publish(collective, strategy, wall, wait, send, ewma)
-
-    def _publish(self, collective, strategy, wall, wait, send, eff) -> None:
-        # re-read the gate every walk (once per walk, not per step):
-        # the profiler is process-global and outlives session epochs,
-        # so a one-shot cache would freeze a pre-enable() answer forever
-        if not tconfig.metrics_enabled():
-            return
-        phases = tmetrics.counter(
-            "kungfu_collective_walk_seconds_total",
-            "Walk wall time attributed to wait-on-recv / reduce+codec "
-            "compute / send-blocked, per collective and strategy",
-            ("collective", "strategy", "phase"),
-        )
-        phases.labels(collective, strategy, "wait").inc(wait)
-        phases.labels(collective, strategy, "send").inc(send)
-        phases.labels(collective, strategy, "compute").inc(
-            max(wall - wait - send, 0.0)
-        )
-        if eff is not None:
-            tmetrics.gauge(
-                "kungfu_collective_efficiency_ratio",
-                "EWMA of achieved walk time vs the 2(k-1)/k*N bandwidth-"
-                "optimal bound at measured link speed (1.0 = optimal)",
-                ("collective", "strategy"),
-            ).labels(collective, strategy).set(eff)
-
-    def snapshot(self) -> Dict[str, dict]:
-        """Per-'collective/strategy' attribution summary; fractions sum
-        to ~1.0 (compute is the residual)."""
-        with self._lock:
-            items = {k: dict(v) for k, v in self._acc.items()}
-        out: Dict[str, dict] = {}
-        for (collective, strategy), a in sorted(items.items()):
-            wall = a["wall"]
-            if wall <= 0:
-                continue
-            wait_f = a["wait"] / wall
-            send_f = a["send"] / wall
-            out[f"{collective}/{strategy}"] = {
-                "walks": a["walks"],
-                "wall_s": wall,
-                "payload_bytes": a["payload_bytes"],
-                "wait_frac": wait_f,
-                "send_frac": send_f,
-                "compute_frac": max(1.0 - wait_f - send_f, 0.0),
-                "achieved_gib_s": a["opt_bytes"] / wall / (1 << 30),
-                "efficiency": a["eff"],
-                "efficiency_samples": a["eff_samples"],
-            }
-        return out
-
-    def signals(self) -> Dict[str, float]:
-        """Adaptation-facing summary for PolicyContext.metrics: the
-        EWMA wait fraction and efficiency of RECENT walks, weighted
-        across walk families by each family's recent wall time (a family
-        that stopped running stops steering the signal; one that turned
-        slow dominates it — all-time sums would go inert on long runs)."""
-        with self._lock:
-            # copy under the lock (like snapshot): the per-key dicts are
-            # mutated by record() on walk threads, and the sums below
-            # must read one consistent state
-            items = [dict(v) for v in self._acc.values()]
-        items = [a for a in items if a["wall_ewma"]]
-        wall = sum(a["wall_ewma"] for a in items)
-        if wall <= 0:
-            return {}
-        out: Dict[str, float] = {
-            "collective/wait_frac": (
-                sum(a["wall_ewma"] * a["wait_frac_ewma"] for a in items) / wall
-            ),
-        }
-        eff_wall = sum(a["wall_ewma"] for a in items if a["eff"] is not None)
-        if eff_wall > 0:
-            out["collective/efficiency"] = (
-                sum(
-                    a["wall_ewma"] * a["eff"]
-                    for a in items
-                    if a["eff"] is not None
-                )
-                / eff_wall
-            )
-        return out
-
-    def reset(self) -> None:
-        with self._lock:
-            self._acc.clear()
-
-
-_walk_profiler = WalkProfiler()
-
-
-def get_walk_profiler() -> WalkProfiler:
-    return _walk_profiler
+if TYPE_CHECKING:
+    from kungfu_tpu.collective.scheduler import CollectiveScheduler
 
 
 class _CollectiveScope:
@@ -477,8 +113,7 @@ class _CollectiveScope:
         return False
 
 
-
-class HostSession:
+class HostSession(WalkEngine, WireCodec, GroupFusion):
     """One collective epoch over a fixed PeerList."""
 
     def __init__(
@@ -520,6 +155,13 @@ class HostSession:
         # wire codec knob: resolved once per session epoch like the
         # strategy; the ACTIVE codec can differ when adaptation toggles it
         self.wire_mode = wire_override()
+        # async scheduler knob: resolved once per session epoch; the
+        # scheduler itself is created lazily on first use (most sessions
+        # — control planes, tests — never submit asynchronously)
+        self.async_mode = knobs.get("KF_CONFIG_ASYNC")
+        self._scheduler: Optional["CollectiveScheduler"] = None
+        self._scheduler_lock = threading.Lock()
+        self._epoch_closed = False
         # adaptive control (parity: session/adaptiveStrategies.go): a
         # deterministic candidate order — identical on every peer — so a
         # majority vote can advance everyone in lockstep. Candidates are
@@ -592,7 +234,7 @@ class HostSession:
         # supplies per-destination bandwidth estimates the profiler
         # scores walks against; the sampler thins per-step spans
         self._links = tlink.get_table() if tlink.enabled() else None
-        self._span_sampler = _SpanSampler(tconfig.span_sample())
+        self._span_sampler = SpanSampler(tconfig.span_sample())
 
     def _candidate(self, idx: int) -> List[st.StrategyPair]:
         if idx not in self._candidates_built:
@@ -605,8 +247,54 @@ class HostSession:
     def size(self) -> int:
         return len(self.peers)
 
-    def close(self) -> None:
-        pass
+    # ------------------------------------------------------------------
+    # async scheduler (ISSUE 10 tentpole)
+    # ------------------------------------------------------------------
+
+    def async_enabled(self) -> bool:
+        """Whether this epoch runs asynchronous group collectives.
+        `auto` resolves to on for multi-peer sessions (a cluster of one
+        has nothing to overlap). Cluster-agreed — the mode decides the
+        fused rendezvous names, so it rides the knob consensus."""
+        if self.async_mode == "on":
+            return True
+        if self.async_mode == "auto":
+            return self.size >= 2
+        return False
+
+    def scheduler(self) -> "CollectiveScheduler":
+        """The session's async collective scheduler, created on first
+        use. Lives exactly as long as the session epoch: Peer._update_to
+        calls :meth:`close` (drain) before replacing the session."""
+        with self._scheduler_lock:
+            if self._scheduler is None:
+                from kungfu_tpu.collective.scheduler import (
+                    CollectiveScheduler,
+                    SchedulerClosed,
+                )
+
+                if self._epoch_closed:
+                    # a resize already ended this epoch: a fresh
+                    # scheduler here would walk against a fenced
+                    # transport token — the caller must re-fetch the
+                    # CURRENT session
+                    raise SchedulerClosed(
+                        "session epoch closed — fetch the current "
+                        "session's scheduler"
+                    )
+                self._scheduler = CollectiveScheduler(self)
+            return self._scheduler
+
+    def close(self, timeout: Optional[float] = None) -> None:
+        """End-of-epoch teardown: drain or cancel the async scheduler's
+        in-flight buckets so nothing from this epoch keeps walking (or
+        writing caller buffers) once the next session exists."""
+        with self._scheduler_lock:
+            sched = self._scheduler
+            self._scheduler = None
+            self._epoch_closed = True
+        if sched is not None:
+            sched.close(timeout=self.timeout if timeout is None else timeout)
 
     def _collected(self, kind: str, nbytes: int):
         """Telemetry wrapper for one public collective: a named span
@@ -614,454 +302,14 @@ class HostSession:
         metrics are on. Returns a context manager."""
         return _CollectiveScope(self, kind, nbytes)
 
-    def _count_wire(
-        self, nbytes: int, strategy_label: str, codec: str = "off",
-        raw_bytes: int = 0,
-    ) -> None:
-        if self._wire_ctr is not None and nbytes:
-            self._wire_ctr.labels(self._wire_kind, strategy_label, codec).inc(nbytes)
-        if (
-            self._wire_saved_ctr is not None
-            and codec != "off"
-            and raw_bytes > nbytes
-        ):
-            self._wire_saved_ctr.labels(self._wire_kind, codec).inc(
-                raw_bytes - nbytes
-            )
-
-    def _record_walk(
-        self,
-        strategy_label: str,
-        k: int,
-        payload_bytes: int,
-        wall: float,
-        prof: "_WalkProfile",
-        dsts=None,
-    ) -> None:
-        """Feed one finished allreduce walk to the process profiler,
-        scored against the slowest link the walk used (all estimated
-        links when `dsts` is None — graph walks fan out over many)."""
-        link_bw = None
-        if self._links is not None:
-            _, link_bw = self._links.min_bandwidth(dsts)
-        _walk_profiler.record(
-            self._wire_kind, strategy_label, k, payload_bytes,
-            wall, prof.wait, prof.send, link_bw,
-        )
-
-    def _walk_label(self) -> str:
-        """Strategy label for graph-walk wire accounting. Labels the
-        graphs that actually EXECUTED: when RING_SEGMENTED is active but
-        a payload fell below SEGMENT_MIN_BYTES, the walk ran the binary-
-        tree fallback graphs and must not pollute the RING_SEGMENTED
-        series (it is the one the optimality assertion reads)."""
-        if self._tree_override:
-            return "SET_TREE"
-        active = self._candidates[self.adaptive.active][0]
-        if active == Strategy.RING_SEGMENTED:
-            return Strategy.BINARY_TREE.name
-        return active.name
-
-    def _active_wire_mode(self) -> str:
-        """The RUNNING codec mode: the active adaptive candidate's wire
-        member, or the configured mode under a set_tree override (an
-        explicit forest replaces the graphs, not the codec)."""
-        if self._tree_override:
-            return self.wire_mode
-        return self._candidates[self.adaptive.active][1]
-
-    def _codec_bypass(self, reason: str, w: Workspace) -> None:
-        """Audit (once per (reason, dtype) per session epoch) that a
-        workspace bypassed an enabled codec — exact semantics preserved
-        for consensus lanes, variance probes and tiny residuals."""
-        key = (reason, w.send.dtype.str)
-        if key in self._codec_bypass_seen:
-            return
-        self._codec_bypass_seen.add(key)
-        from kungfu_tpu.telemetry import audit as _audit
-
-        _audit.record_event(
-            "wire_codec_bypass",
-            peer=str(self.self_id),
-            reason=reason,
-            dtype=w.send.dtype.str,
-            name=w.name,
-            nbytes=int(w.recv.nbytes),
-        )
-
-    def _wire_codec_for(self, w: Workspace) -> Optional[DType]:
-        """Codec decision for one allreduce workspace, or None (raw).
-
-        MUST depend only on cluster-agreed inputs — the resolved wire
-        mode (env + lockstep adaptive votes) and workspace properties
-        identical on every peer — because it decides the byte count of
-        every message in the walk. Non-f32 payloads (consensus lanes,
-        int gradients) and sub-WIRE_MIN_BYTES residuals bypass with an
-        audit event, never an error."""
-        mode = self._active_wire_mode()
-        if mode == "off":
-            return None
-        if w.send.dtype != np.float32:
-            self._codec_bypass("non_f32", w)
-            return None
-        if w.recv.nbytes < self.WIRE_MIN_BYTES:
-            self._codec_bypass("below_min_bytes", w)
-            return None
-        return _WIRE_DTYPE[mode]
-
-    def _recv_collective(
-        self, peer: PeerID, name: str, nbytes: int, dtype, count: int,
-        timeout: float,
-    ):
-        """Receive (peer, name) into a pooled scratch buffer — delivered
-        straight off the socket when we're parked first (sink path), else
-        from the buffered Message (possibly a zero-copy shm borrow).
-        Returns (ndarray view, scratch-or-None to return to the pool,
-        release-or-None to call once the view has been consumed). Shared
-        by the graph walk and the segmented walk so the borrow/release/
-        leak-on-timeout contract lives in ONE place. On error the scratch
-        is deliberately NOT returned to the pool: a timed-out sink may
-        still be mid-fill by the transport thread."""
-        bufpool = get_buffer_pool()
-        scratch = bufpool.get(nbytes)
-        msg, filled = self.endpoint.recv_into(
-            peer, name, memoryview(scratch), timeout
-        )
-        if filled:
-            return np.frombuffer(scratch, dtype, count), scratch, None
-        bufpool.put(scratch)  # unused: sender raced us or size mismatch
-        return np.frombuffer(msg.data, dtype, count), None, msg.release
-
     # ------------------------------------------------------------------
     # public collectives
     # ------------------------------------------------------------------
-
-    # Segmentation pays only when the per-step segment amortizes the
-    # 2*(k-1) serialized message latencies; below this the rank-0 binary
-    # tree fallback graphs win. MUST be cluster-agreed (it decides which
-    # rendezvous names a peer waits on) — like CHUNK_BYTES, the default
-    # is a constant and the env override must be set fleet-wide.
-    SEGMENT_MIN_BYTES = int(knobs.get("KF_CONFIG_SEGMENT_MIN_BYTES"))
-
-    # Codec floor: encoding pays two passes (encode + decode) to halve
-    # the wire bytes, which only wins once the payload dwarfs the fixed
-    # per-walk costs; tiny control collectives also stay exact this way.
-    # Cluster-agreed like SEGMENT_MIN_BYTES (it decides message sizes).
-    WIRE_MIN_BYTES = int(knobs.get("KF_CONFIG_WIRE_MIN_BYTES"))
-
-    def _segmented_active(self) -> bool:
-        return (
-            not self._tree_override
-            and self.size >= 2
-            and self._candidates[self.adaptive.active][0]
-            == Strategy.RING_SEGMENTED
-        )
-
-    def _allreduce_ws(
-        self,
-        w: Workspace,
-        cancel: Optional[threading.Event] = None,
-        defer_decode: bool = False,
-    ) -> Optional[_DeferredDecode]:
-        """Engine dispatch for one allreduce workspace: the segmented
-        ring walk when RING_SEGMENTED is active and the payload is worth
-        segmenting, else chunked graph walks. `cancel` (group/window
-        scope) propagates so an abandoned walk observes the caller's
-        timeout before mutating recv buffers.
-
-        With `defer_decode=True` a compressed segmented walk skips its
-        walk-end decode and returns the wire buffer as a
-        _DeferredDecode (w.recv is then NOT fully written!); every
-        other path returns None and w.recv holds the result."""
-        wire = self._wire_codec_for(w)
-        if self._segmented_active() and w.recv.nbytes >= self.SEGMENT_MIN_BYTES:
-            return self._run_segmented(
-                w, cancel=cancel, wire=wire, defer_decode=defer_decode
-            )
-        self._run_strategies(w, self.global_strategies, cancel, wire=wire)
-        return None
 
     def all_reduce(self, w: Workspace) -> None:
         with self._collected("all_reduce", w.recv.nbytes):
             with stall_detect(f"all_reduce({w.name})"):
                 self._allreduce_ws(w)
-
-    # concurrent workspaces per batch in group ops: concurrency only pays
-    # when cores exist to run the walks (on a 1-core host it just adds
-    # context switches), so the default scales with the cgroup-aware
-    # core count — os.cpu_count() reports the HOST's cores inside a
-    # CPU-quota'd container, the phantom-parallelism trap auto_select
-    # already avoids; KF_CONFIG_GROUP_WINDOW overrides
-    GROUP_WINDOW = int(
-        knobs.get("KF_CONFIG_GROUP_WINDOW")
-        or max(1, min(8, effective_cpu_count()))
-    )
-
-    # Gradient bucketing: fuse same-(dtype, op) workspaces into ONE
-    # contiguous walk. A 160-tensor gradient set otherwise pays the fixed
-    # per-walk cost (rendezvous conditions, pool dispatch, ~6 framed
-    # messages) 160 times — on a host-plane reduce that overhead rivals
-    # the byte-copy time itself. Two extra memcpy passes (pack + unpack)
-    # buy a ~160x cut in message count. The reference runs one collective
-    # per tensor and leans on cheap goroutines instead; bucketing is the
-    # standard DDP/Horovod answer and is strictly better here.
-    FUSE_MIN_TENSORS = int(knobs.get("KF_CONFIG_GROUP_FUSE_MIN"))
-
-    # Fused-bucket size cap: fused groups split into buckets that pack /
-    # walk / unpack as a 3-stage pipeline, so the cap trades per-walk
-    # fixed cost (bigger buckets) against pack/unpack overlap (smaller
-    # buckets start their walk sooner and unpack while the next bucket is
-    # on the wire). Measured on the 2-core bench box: 8 MiB buckets pay
-    # 12 walks' fixed cost for resnet50 and run 2x SLOWER than one big
-    # bucket; 64 MiB is within noise of a single bucket while still
-    # pipelining multi-hundred-MB sets (bert ~700 MB -> 11 buckets).
-    # Part of the fused workspace name, so it MUST be cluster-agreed
-    # like CHUNK_BYTES (which also rules out core-count scaling here).
-    GROUP_BUCKET_BYTES = int(knobs.get("KF_CONFIG_GROUP_BUCKET_BYTES"))
-
-    def group_all_reduce(self, ws: Sequence[Workspace]) -> None:
-        """Allreduce of many workspaces as one windowed group op (parity:
-        the reference reduces a whole gradient set per session.run —
-        srcs/python/kungfu/tensorflow/v1/benchmarks). Fused buckets run
-        through the 3-stage pipeline while the singles windows walk
-        concurrently — neither waits for the other to finish."""
-        if not ws:
-            return
-        with self._collected(
-            "group_all_reduce", sum(w.recv.nbytes for w in ws)
-        ), stall_detect(f"group_all_reduce[{len(ws)}]"):
-            singles: List[Workspace] = []
-            groups: Dict[tuple, List[Workspace]] = {}
-            for w in ws:
-                if w.is_empty:
-                    continue
-                groups.setdefault((w.send.dtype.str, int(w.op)), []).append(w)
-            buckets: List[List[Workspace]] = []
-            for members in groups.values():
-                if len(members) < self.FUSE_MIN_TENSORS:
-                    singles.extend(members)
-                else:
-                    buckets.extend(self._make_buckets(members))
-            jobs: List[Callable[[], None]] = []
-            # the group deadline scales with the number of walks it
-            # covers — the serial predecessor allowed one self.timeout
-            # PER fused walk / singles window, and a large healthy group
-            # on a slow link must not trip a single flat budget
-            windows = -(-len(singles) // self.GROUP_WINDOW)
-            group_timeout = self.timeout * max(1, len(buckets) + windows)
-            # shared cancel: a group-level timeout must also abort the
-            # pipeline stages, or a lingering unpacker would keep writing
-            # caller recv buffers after this call already raised (the
-            # late-write hazard _par's contract exists to prevent)
-            cancel = threading.Event()
-            if buckets:
-                jobs.append(
-                    lambda: self._fused_pipeline(buckets, group_timeout, cancel)
-                )
-            if singles:
-                jobs.append(lambda: self._singles_windows(singles, cancel))
-            _par(jobs, group_timeout, cancel)
-
-    def _make_buckets(
-        self, members: List[Workspace]
-    ) -> List[List[Workspace]]:
-        """Greedy, order-preserving packing of same-(dtype, op)
-        workspaces into <= GROUP_BUCKET_BYTES buckets. Derived only from
-        the caller's tensor order and the byte cap, so every peer computes
-        the same layout (the fused name encodes it); an oversized single
-        tensor gets a bucket of its own."""
-        buckets: List[List[Workspace]] = []
-        cur: List[Workspace] = []
-        cur_bytes = 0
-        for w in members:
-            if cur and cur_bytes + w.send.nbytes > self.GROUP_BUCKET_BYTES:
-                buckets.append(cur)
-                cur, cur_bytes = [], 0
-            cur.append(w)
-            cur_bytes += w.send.nbytes
-        if cur:
-            buckets.append(cur)
-        return buckets
-
-    def _singles_windows(
-        self,
-        singles: List[Workspace],
-        cancel: Optional[threading.Event] = None,
-    ) -> None:
-        for i in range(0, len(singles), self.GROUP_WINDOW):
-            if cancel is not None and cancel.is_set():
-                # the group already raised (timeout, or a pipeline-stage
-                # error that set the shared cancel): stop launching
-                # windows, but return QUIETLY — raising here would race
-                # the real error to _par's errs[0] and misreport a
-                # deterministic failure as 'cancelled'
-                return
-            batch = singles[i : i + self.GROUP_WINDOW]
-            _par(
-                [lambda w=w: self._allreduce_ws(w, cancel) for w in batch],
-                self.timeout,
-                cancel,
-            )
-
-    def _pack_bucket(self, bi: int, members: List[Workspace]):
-        """Pack one bucket into pooled contiguous buffers. Workspace
-        order is the caller's tensor order, identical on every peer, so
-        the fused name and layout agree cluster-wide.
-
-        When the wire codec will compress this bucket, members are
-        packed straight into ONE buffer that doubles as the walk's f32
-        accumulator (an inplace workspace): all wire staging already
-        happens in pooled 2-byte scratches inside the walk, so the
-        second full-size f32 buffer (and its memcpy) of the raw path
-        buys nothing. Inplace fused workspaces are valid on every walk
-        path, so a mid-flight adaptive codec toggle stays correct."""
-        dtype = members[0].send.dtype
-        op = members[0].op
-        total = sum(w.send.size for w in members)
-        nbytes = total * dtype.itemsize
-        pool = get_buffer_pool()
-        single = (
-            self._active_wire_mode() != "off"
-            and dtype == np.float32
-            and nbytes >= self.WIRE_MIN_BYTES
-        )
-        send_b = pool.get(nbytes)
-        recv_b = None if single else pool.get(nbytes)
-        with trace.span("host.fuse.pack"):
-            send = np.frombuffer(send_b, dtype, total)
-            recv = send if single else np.frombuffer(recv_b, dtype, total)
-            off = 0
-            for w in members:
-                send[off : off + w.send.size] = w.send
-                off += w.send.size
-        fused = Workspace(
-            send=send,
-            recv=recv,
-            op=op,
-            name=f"{members[0].name}::fused:b{bi}:{len(members)}x{total}",
-        )
-        return (fused, send_b, recv_b, members)
-
-    def _unpack_bucket(self, item) -> None:
-        fused, send_b, recv_b, members, deferred = item
-        pool = get_buffer_pool()
-        try:
-            with trace.span("host.fuse.unpack"):
-                off = 0
-                if deferred is not None:
-                    # fused decode+unpack: the compressed walk handed us
-                    # its wire buffer instead of decoding into the fused
-                    # recv first — one full f32 pass saved per bucket
-                    for w in members:
-                        deferred.decode_into(w.recv, off, off + w.recv.size)
-                        off += w.recv.size
-                else:
-                    for w in members:
-                        np.copyto(w.recv, fused.recv[off : off + w.recv.size])
-                        off += w.recv.size
-        finally:
-            if deferred is not None:
-                deferred.close()
-            pool.put(send_b)
-            if recv_b is not None:
-                pool.put(recv_b)
-
-    def _fused_pipeline(
-        self,
-        buckets: List[List[Workspace]],
-        timeout: float,
-        cancel: Optional[threading.Event] = None,
-    ) -> None:
-        """3-stage software pipeline over fused buckets: pack bucket i+1
-        and unpack bucket i-1 while bucket i is on the wire. The serial
-        predecessor (all packs, then all walks, then all unpacks per
-        bucket) left the wire idle during every memcpy phase. Depth-1
-        handoff queues bound live pooled buffers at 5 buckets (one per
-        stage + one per queue) — x2 buffers x GROUP_BUCKET_BYTES, well
-        under the serial path's single whole-group buffer pair for big
-        sets. Every queue get/put is abort-aware, so any stage's failure
-        (or a dropped sentinel after one) unblocks the other two and the
-        REAL error propagates out of _par; aborted in-flight buffers are
-        dropped to GC (the pool's documented policy for buffers a worker
-        may still touch)."""
-        packed: "queue.Queue" = queue.Queue(maxsize=1)
-        unpackq: "queue.Queue" = queue.Queue(maxsize=1)
-        # the caller's cancel event doubles as the abort flag: _par sets
-        # it on timeout, so every stage (unpacker included) stops before
-        # touching caller buffers again
-        abort = cancel if cancel is not None else threading.Event()
-
-        def put(q: "queue.Queue", item) -> bool:
-            """Bounded put that gives up once the pipeline aborts."""
-            while True:
-                try:
-                    q.put(item, timeout=0.2)
-                    return True
-                except queue.Full:
-                    if abort.is_set():
-                        return False
-
-        def get(q: "queue.Queue"):
-            """Blocking get that turns into the sentinel on abort, so a
-            consumer can never be stranded by a lost sentinel."""
-            while True:
-                try:
-                    return q.get(timeout=0.2)
-                except queue.Empty:
-                    if abort.is_set():
-                        return None
-
-        def packer():
-            try:
-                for bi, members in enumerate(buckets):
-                    if abort.is_set():
-                        return
-                    if not put(packed, self._pack_bucket(bi, members)):
-                        return
-            except BaseException:
-                abort.set()
-                raise
-            finally:
-                put(packed, None)
-
-        def walker():
-            try:
-                while True:
-                    item = get(packed)
-                    if item is None:
-                        return
-                    if abort.is_set():
-                        continue  # drain to the sentinel
-                    with trace.span("host.fuse.walk"):
-                        # defer the codec's walk-end decode to the
-                        # unpacker, which fuses it with the member
-                        # scatter (an aborted in-flight wire buffer is
-                        # dropped to GC like every other staging buffer)
-                        deferred = self._allreduce_ws(
-                            item[0], defer_decode=True
-                        )
-                    if not put(unpackq, item + (deferred,)):
-                        return
-            except BaseException:
-                abort.set()
-                raise
-            finally:
-                put(unpackq, None)
-
-        def unpacker():
-            try:
-                while True:
-                    item = get(unpackq)
-                    if item is None:
-                        return
-                    if abort.is_set():
-                        continue  # aborted: must not touch caller buffers
-                    self._unpack_bucket(item)
-            except BaseException:
-                abort.set()
-                raise
-
-        _par([packer, walker, unpacker], timeout, abort)
 
     def monitored_all_reduce(self, w: Workspace) -> None:
         """AllReduce + throughput accounting for the ACTIVE strategy
@@ -1089,7 +337,14 @@ class HostSession:
         majority every peer advances to the next candidate strategy in the
         same deterministic order. Returns True if the strategy switched.
         Parity: CheckInterference + MonitoredAllReduce consensus switch
-        (session/adaptiveStrategies.go:61-121)."""
+        (session/adaptiveStrategies.go:61-121).
+
+        Call this at a step boundary. With the async scheduler active
+        the switch lands at a bucket boundary by construction: walks are
+        launched one at a time from the scheduler thread and re-read the
+        active candidate per workspace, and the flush() barrier that
+        ends every round means no bucket of the PREVIOUS round is still
+        in flight when the vote's allreduce runs."""
         if self._tree_override or len(self._candidates) < 2:
             return False
         suspect = self.adaptive.current.suspect_interference()
@@ -1128,10 +383,24 @@ class HostSession:
 
     def active_strategy(self) -> Optional[Strategy]:
         """The running candidate strategy, or None when an explicit
-        set_tree forest overrides the candidates."""
+        set_tree forest overrides the candidates. This is the Strategy-
+        typed accessor; the operator-facing codec-qualified name lives
+        in :meth:`active_candidate_name` (ISSUE 10 satellite — the two
+        contracts used to be conflated at the api layer)."""
         if self._tree_override:
             return None
         return self._candidates[self.adaptive.active][0]
+
+    def active_candidate_name(self) -> str:
+        """Display name of the running adaptive candidate: the strategy,
+        suffixed with "/<codec>" when a wire codec is active (an
+        interference vote may have toggled compression rather than the
+        graphs); "SET_TREE" under a set_tree override."""
+        s = self.active_strategy()
+        if s is None:
+            return "SET_TREE"
+        wire = self._active_wire_mode()
+        return s.name if wire == "off" else f"{s.name}/{wire}"
 
     def set_tree(self, fathers: Sequence[int]) -> None:
         """Install a runtime forest (e.g. an MST over probed latencies) as
@@ -1293,8 +562,10 @@ class HostSession:
         Every entry decides rendezvous names, message sizes or peer
         pairings, so peers that resolved different values would wait on
         each other's names (or mis-frame messages) forever. Local-only
-        tuning (KF_CONFIG_GROUP_WINDOW — pure intra-host concurrency) is
-        deliberately excluded: it may legitimately differ per host."""
+        tuning (KF_CONFIG_GROUP_WINDOW — pure intra-host concurrency —
+        and KF_CONFIG_ASYNC_QUEUE, the scheduler's local in-flight
+        depth) is deliberately excluded: it may legitimately differ per
+        host."""
         return [
             ("KF_CONFIG_ALGO", knobs.get("KF_CONFIG_ALGO")),
             ("KF_CONFIG_CHUNK_BYTES", str(CHUNK_BYTES)),
@@ -1303,6 +574,7 @@ class HostSession:
             ("KF_CONFIG_GROUP_FUSE_MIN", str(self.FUSE_MIN_TENSORS)),
             ("KF_CONFIG_WIRE", self.wire_mode),
             ("KF_CONFIG_WIRE_MIN_BYTES", str(self.WIRE_MIN_BYTES)),
+            ("KF_CONFIG_ASYNC", self.async_mode),
         ]
 
     def _fixed_allreduce(self, w: Workspace) -> None:
@@ -1317,26 +589,26 @@ class HostSession:
         """Fail fast on engine-knob divergence (satellite of ISSUE 5).
 
         Without this, peers that resolved different KF_CONFIG_ALGO /
-        CHUNK_BYTES / GROUP_BUCKET_BYTES / WIRE values wait on each
-        other's rendezvous names forever — the first collective of the
-        epoch just hangs. One consensus over the resolved knob tuple at
-        session start turns that into an immediate, named error. Runs on
-        the knob-independent star walk, so the check itself cannot
+        CHUNK_BYTES / GROUP_BUCKET_BYTES / WIRE / ASYNC values wait on
+        each other's rendezvous names forever — the first collective of
+        the epoch just hangs. One consensus over the resolved knob tuple
+        at session start turns that into an immediate, named error. Runs
+        on the knob-independent star walk, so the check itself cannot
         deadlock on the very disagreement it detects; on mismatch a
         per-knob round pins down WHICH knob diverged."""
         if self.size < 2:
             return
-        knobs = self.engine_knobs()
-        blob = ";".join(f"{k}={v}" for k, v in knobs).encode()
+        resolved = self.engine_knobs()
+        blob = ";".join(f"{k}={v}" for k, v in resolved).encode()
         if self._bytes_agree(blob, ":knobs", self._fixed_allreduce):
             return
         bad = [
-            k for k, v in knobs
+            k for k, v in resolved
             if not self._bytes_agree(
                 v.encode(), f":knob:{k}", self._fixed_allreduce
             )
         ]
-        mine = dict(knobs)
+        mine = dict(resolved)
         names = ", ".join(bad) if bad else "engine knob tuple"
         raise RuntimeError(
             f"engine knob mismatch across peers: {names} — these KF_CONFIG_* "
@@ -1440,628 +712,3 @@ class HostSession:
         self.gather(w)
         bw = Workspace(send=w.recv, recv=w.recv, op=w.op, name=w.name + ":bcast")
         self.broadcast(bw)
-
-    # ------------------------------------------------------------------
-    # engine
-    # ------------------------------------------------------------------
-
-    def _run_segmented(
-        self,
-        w: Workspace,
-        ranks: Optional[Sequence[int]] = None,
-        cancel: Optional[threading.Event] = None,
-        wire: Optional[DType] = None,
-        defer_decode: bool = False,
-    ) -> Optional[_DeferredDecode]:
-        """Bandwidth-optimal segmented walk: a (k-1)-step reduce-scatter
-        over contiguous segments followed by a (k-1)-step all-gather
-        around a ring (arXiv:1810.11112 §3; the TPU-pod MLPerf stack
-        leans on the same segmented summation, arXiv:1909.09756). Each
-        step sends ONE ~N/k segment to the ring successor and reduces
-        (or, in the gather phase, copies) the segment arriving from the
-        predecessor in place — zero-copy views into the recv buffer, no
-        full-payload relays, ~2*(k-1)/k*N bytes moved per peer total.
-
-        With `wire` set (the codec, ISSUE 5) each segment crosses the
-        transport as bf16/f16 — half the bytes, 2*(k-1)/k*N/2 per peer:
-
-        * reduce-scatter: the sender encodes its f32 partial into a
-          pooled wire scratch; the receiver decode-accumulates into the
-          f32 buffer in one fused pass, so every transmitted value is
-          quantized exactly once and no rounding compounds in 16-bit
-          storage across the (k-1) steps;
-        * all-gather: segments STAY in wire dtype in a walk-local wire
-          buffer — each already-reduced segment is quantized once by its
-          owner, relayed untouched, and decoded exactly once per peer at
-          walk end (the owner decodes its own encoding too, so every
-          peer lands on bit-identical results).
-
-        Contracts shared with the graph walk: receives prefer the
-        zero-copy sink/shm-borrow path (`recv_into`) and release borrows
-        after the in-place reduce; one deadline bounds the WHOLE walk (not
-        per step); a timed-out scratch buffer is never returned to the
-        pool (the transport thread may still be mid-fill); empty segments
-        (payload < k elements) are skipped identically on both ends of
-        every edge, so no peer waits on a message that never departs.
-
-        `ranks` restricts the ring to a subset (hierarchical cross-host
-        mode); non-members just forward send into recv. With
-        `defer_decode` (compressed walks only) the walk-end decode is
-        skipped and the wire buffer returned — see _DeferredDecode."""
-        if w.is_empty:
-            w.forward()
-            return None
-        members = list(range(self.size)) if ranks is None else list(ranks)
-        k = len(members)
-        if self.rank not in members or k == 1:
-            w.forward()
-            return None
-        sched = topo.gen_segmented_schedule(members, members.index(self.rank))
-        bounds = even_partition(w.recv.size, k)
-        w.forward()  # seed the accumulator with own contribution
-        acc = w.recv
-        send_peer = self.peers[sched.send_peer]
-        recv_peer = self.peers[sched.recv_peer]
-        itemsize = acc.itemsize
-        wire_itemsize = 2 if wire is not None else itemsize
-        codec_label = wire.name.lower() if wire is not None else "off"
-        bufpool = get_buffer_pool()
-        deadline = time.monotonic() + self.timeout
-        wire_bytes = 0
-        raw_bytes = 0
-        # critical-path attribution for this walk (profiler, ISSUE 6):
-        # wait-on-recv and send-blocked seconds of THIS thread; the
-        # reduce/codec compute is the residual against walk wall time
-        prof = _WalkProfile()
-        emit_steps = self._span_sampler.sample()
-        # all-gather wire buffer: segments stay encoded here from the
-        # owner's single quantization until the walk-end decode. Leaked
-        # (not pool-returned) on any error — the transport may still be
-        # mid-fill into a timed-out sink slice.
-        wirebuf: Optional[bytearray] = None
-        wirearr: Optional[np.ndarray] = None
-        if wire is not None:
-            wirebuf = bufpool.get(acc.size * 2)
-            wirearr = np.frombuffer(wirebuf, np.uint16, acc.size)
-
-        def do_send(name: str, sb: int, se: int, buf) -> None:
-            """Deadline-bounded send: a frozen successor (full shm ring
-            -> socket fallback -> full TCP buffer) would otherwise block
-            sendall forever and the walk-wide deadline — checked only in
-            do_recv — would never fire. Dispatch + event-wait costs tens
-            of µs per step, noise against the segment memcpy. A timed-out
-            send thread is abandoned exactly like the graph walk's _par
-            send threads; the buffer stays valid because the caller
-            raises out of the walk without touching acc again."""
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(f"segmented walk timed out: {name}")
-            done = threading.Event()
-            errs: List[BaseException] = []
-
-            def run() -> None:
-                try:
-                    # zero-copy: segments are disjoint and steps
-                    # sequential per workspace, so this view cannot be
-                    # mutated mid-sendall
-                    self.client.send(
-                        send_peer, name, _buf(buf), ConnType.COLLECTIVE
-                    )
-                except BaseException as e:  # noqa: BLE001 - re-raised below
-                    errs.append(e)
-                finally:
-                    done.set()
-
-            _t_send = time.perf_counter()
-            get_pool().submit(run)
-            ok = done.wait(remaining)
-            prof.send += time.perf_counter() - _t_send
-            if not ok:
-                raise TimeoutError(f"segmented send timed out: {name}")
-            if errs:
-                raise errs[0]
-
-        def start_send_wire(name: str, sb: int, se: int, buf):
-            """Async wire-mode send: encode (when `buf` is an f32 view)
-            and transport copy run on the pool thread so they OVERLAP
-            the blocking predecessor recv — the codec's encode would
-            otherwise sit on the ring's serialized critical path, which
-            a time-sliced multi-worker host punishes step after step.
-            Safe because a step's send and recv segments are disjoint by
-            schedule construction, so the thread reads acc[sb:se] (or a
-            wirearr slice) while the main thread fills a different
-            segment. Returns (done, errs) for finish_send; the encode
-            scratch is pool-returned by the thread itself (never while
-            anything can still read it)."""
-            done = threading.Event()
-            errs: List[BaseException] = []
-
-            def run() -> None:
-                try:
-                    if buf.dtype == np.uint16:
-                        payload = buf  # all-gather: already wire dtype
-                        scratch = None
-                    else:
-                        scratch = bufpool.get((se - sb) * 2)
-                        payload = np.frombuffer(scratch, np.uint16, se - sb)
-                        encode_wire(payload, buf, wire)
-                    self.client.send(
-                        send_peer, name, _buf(payload), ConnType.COLLECTIVE
-                    )
-                    if scratch is not None:
-                        bufpool.put(scratch)
-                except BaseException as e:  # noqa: BLE001 - re-raised below
-                    errs.append(e)
-                finally:
-                    done.set()
-
-            get_pool().submit(run)
-            return done, errs
-
-        def finish_send(pending, name: str) -> None:
-            done, errs = pending
-            remaining = deadline - time.monotonic()
-            _t_send = time.perf_counter()
-            ok = remaining > 0 and done.wait(remaining)
-            prof.send += time.perf_counter() - _t_send
-            if not ok:
-                raise TimeoutError(f"segmented send timed out: {name}")
-            if errs:
-                raise errs[0]
-
-        def recv_rs(name: str, rb: int, re_: int) -> None:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(f"segmented walk timed out: {name}")
-            recv_dtype = np.dtype(np.uint16) if wire is not None else acc.dtype
-            _t_recv = time.perf_counter()
-            incoming, scratch, release = self._recv_collective(
-                recv_peer, name, (re_ - rb) * wire_itemsize, recv_dtype,
-                re_ - rb, remaining,
-            )
-            prof.wait += time.perf_counter() - _t_recv
-            try:
-                if cancel is not None and cancel.is_set():
-                    # caller-scope timeout fired while we were blocked:
-                    # the recv buffer may already be reused — a late
-                    # arrival must not be reduced into it
-                    raise TimeoutError(f"collective cancelled: {name}")
-                if wire is not None:
-                    # fused decode + f32 accumulate: one pass, one
-                    # quantization deep (the sender's encode)
-                    decode_accumulate(acc, rb, re_, incoming, wire, w.op)
-                else:
-                    reduce_segment(acc, rb, re_, incoming, w.op)
-            finally:
-                del incoming
-                if release is not None:
-                    release()
-            if scratch is not None:
-                bufpool.put(scratch)
-
-        def recv_ag(name: str, rb: int, re_: int) -> None:
-            remaining = deadline - time.monotonic()
-            if remaining <= 0:
-                raise TimeoutError(f"segmented walk timed out: {name}")
-            if wire is None:
-                _t_recv = time.perf_counter()
-                incoming, scratch, release = self._recv_collective(
-                    recv_peer, name, (re_ - rb) * itemsize, acc.dtype,
-                    re_ - rb, remaining,
-                )
-                prof.wait += time.perf_counter() - _t_recv
-                try:
-                    if cancel is not None and cancel.is_set():
-                        raise TimeoutError(f"collective cancelled: {name}")
-                    copy_segment(acc, rb, re_, incoming)
-                finally:
-                    del incoming
-                    if release is not None:
-                        release()
-                if scratch is not None:
-                    bufpool.put(scratch)
-                return
-            # wire mode: deliver straight into the wire buffer slice —
-            # no scratch, no decode (the segment is relayed as-is and
-            # decoded once at walk end)
-            _t_recv = time.perf_counter()
-            msg, filled = self.endpoint.recv_into(
-                recv_peer, name, memoryview(wirebuf)[rb * 2 : re_ * 2],
-                remaining,
-            )
-            prof.wait += time.perf_counter() - _t_recv
-            if cancel is not None and cancel.is_set():
-                if msg is not None and msg.release is not None:
-                    msg.release()
-                raise TimeoutError(f"collective cancelled: {name}")
-            if not filled:
-                try:
-                    np.copyto(
-                        wirearr[rb:re_],
-                        np.frombuffer(msg.data, np.uint16, re_ - rb),
-                    )
-                finally:
-                    if msg.release is not None:
-                        msg.release()
-
-        def step(phase: str, s: int, send_seg: int, recv_seg: int) -> None:
-            nonlocal wire_bytes, raw_bytes
-            sb, se = bounds[send_seg]
-            rb, re_ = bounds[recv_seg]
-            name = f"{w.name}:{phase}{s}"
-            if cancel is not None and cancel.is_set():
-                raise TimeoutError(f"collective cancelled: {name}")
-            # empty segments (payload < k elements) are skipped on BOTH
-            # ends: sender and receiver compute identical bounds.
-            # RAW mode: send-then-recv is deliberately SEQUENTIAL — the
-            # send returns once the payload is in the shm ring / kernel
-            # buffer, so the wire is already busy while we block on the
-            # predecessor, and a _par pair per step measured 15% slower
-            # on the 2-core bench box (thread dispatch + GIL beat the
-            # overlap). WIRE mode: the encode pass makes the send phase
-            # heavy enough to flip that trade — encode+send run async on
-            # the pool thread and overlap the predecessor wait, awaited
-            # at step end (disjoint segments make this safe).
-            if se > sb:
-                wire_bytes += (se - sb) * wire_itemsize
-                raw_bytes += (se - sb) * itemsize
-            if wire is not None:
-                pending = None
-                if se > sb:
-                    pending = start_send_wire(
-                        name, sb, se,
-                        acc[sb:se] if phase == "rs" else wirearr[sb:se],
-                    )
-                if re_ > rb:
-                    if phase == "rs":
-                        recv_rs(name, rb, re_)
-                    else:
-                        recv_ag(name, rb, re_)
-                if pending is not None:
-                    finish_send(pending, name)
-                return
-            if se > sb:
-                do_send(name, sb, se, acc[sb:se])
-            if re_ > rb:
-                if phase == "rs":
-                    recv_rs(name, rb, re_)
-                else:
-                    recv_ag(name, rb, re_)
-
-        def timed_step(span_name: str, phase: str, s: int, snd: int, rcv: int) -> None:
-            """One ring step, with a per-step span (subject to
-            KF_TELEMETRY_SPAN_SAMPLE) annotated with how long the step
-            was blocked waiting on its predecessor vs its successor."""
-            if not emit_steps:
-                step(phase, s, snd, rcv)
-                return
-            w0, s0 = prof.wait, prof.send
-            with trace.span(span_name, step=s, k=k) as sp:
-                step(phase, s, snd, rcv)
-                sp.args["wait_us"] = round((prof.wait - w0) * 1e6)
-                sp.args["send_us"] = round((prof.send - s0) * 1e6)
-
-        _t0 = time.perf_counter()
-        for s, (snd, rcv) in enumerate(sched.rs_steps):
-            timed_step("host.rs.step", "rs", s, snd, rcv)
-        if wire is not None:
-            # seed the all-gather: quantize the owned (fully reduced)
-            # segment ONCE; every peer — self included — will decode
-            # this same encoding, so results stay bit-identical ringwide
-            ob, oe = bounds[sched.owned_segment]
-            if oe > ob:
-                encode_wire(wirearr[ob:oe], acc[ob:oe], wire)
-        for s, (snd, rcv) in enumerate(sched.ag_steps):
-            timed_step("host.ag.step", "ag", s, snd, rcv)
-        deferred: Optional[_DeferredDecode] = None
-        if wire is not None:
-            if defer_decode:
-                deferred = _DeferredDecode(wire, wirebuf, wirearr)
-            else:
-                with trace.span("host.wire.decode", bytes=int(acc.size * 2)):
-                    decode_wire(acc, wirearr, wire)
-                bufpool.put(wirebuf)
-        self._count_wire(
-            wire_bytes, Strategy.RING_SEGMENTED.name, codec_label, raw_bytes
-        )
-        wall = time.perf_counter() - _t0
-        trace.record(f"host.segmented[{w.recv.nbytes >> 20}MiB]", wall)
-        # the ring's only outgoing edge is the successor: score this walk
-        # against that link's measured bandwidth
-        self._record_walk(
-            Strategy.RING_SEGMENTED.name, k, w.recv.nbytes, wall, prof,
-            dsts=[send_peer],
-        )
-        return deferred
-
-    def _run_strategies(
-        self,
-        w: Workspace,
-        strategies: List[st.StrategyPair],
-        cancel: Optional[threading.Event] = None,
-        wire: Optional[DType] = None,
-    ) -> None:
-        """`wire` is decided ONCE on the whole workspace (in
-        _allreduce_ws) and inherited by every chunk — a per-chunk
-        decision would let a residual chunk fall below WIRE_MIN_BYTES
-        and mix wire formats inside one collective (still cluster-
-        consistent, but pointlessly branchy on the hot path)."""
-        total = w.recv.size * w.recv.itemsize
-        k = max(1, -(-total // choose_chunk_bytes(total)))
-        chunks = w.split(even_partition, k) if k > 1 else [w]
-        if cancel is None:
-            cancel = threading.Event()
-        if k == 1:
-            pair = strategies[0]
-            self._run_graphs(
-                chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel,
-                wire, profile=True,
-            )
-            return
-        jobs = []
-        for i, chunk in enumerate(chunks):
-            pair = st.choose(strategies, i)
-            jobs.append(
-                lambda c=chunk, p=pair: self._run_graphs(
-                    c, [p.reduce_graph, p.bcast_graph], cancel, wire,
-                    profile=True,
-                )
-            )
-        _par(jobs, self.timeout, cancel)
-
-    def _run_graphs(
-        self,
-        w: Workspace,
-        graphs: List[Graph],
-        cancel: Optional[threading.Event] = None,
-        wire: Optional[DType] = None,
-        profile: bool = False,
-    ) -> None:
-        """The hot walk; parity: runGraphs (session.go:231-299).
-
-        `profile=True` (the allreduce paths, via _run_strategies) feeds
-        this walk's wait/send/compute attribution to the process
-        WalkProfiler; direct reduce/broadcast/gather walks skip it (the
-        2(k-1)/k*N allreduce bound doesn't describe them).
-
-        `cancel` is shared across every thread touching this workspace: once
-        any part of the collective times out, late-arriving receives must not
-        write into (possibly reused) caller buffers.
-
-        With `wire` set, every send encodes the f32 buffer into a pooled
-        bf16/f16 scratch and every receive decode-accumulates (reduce
-        phase) or decodes (bcast phase) back into f32 — accumulation
-        never happens in 16-bit storage. Relays re-encode values that
-        are already wire-quantized, which is exact (encode of an
-        exactly-representable value is the identity), so the quantized
-        result every peer converges on is bit-identical."""
-        if w.is_empty:
-            return
-        if all(g.is_isolated(self.rank) for g in graphs):
-            w.forward()
-            return
-        if cancel is None:
-            cancel = threading.Event()
-        _t_walk = time.perf_counter()
-        prof = _WalkProfile() if profile else None
-
-        state = {"recv_count": 0}
-        lock = threading.Lock()
-
-        def effective() -> np.ndarray:
-            if state["recv_count"] > 0 or w.is_inplace:
-                return w.recv
-            return w.send
-
-        wire_label = self._walk_label()
-        codec_label = wire.name.lower() if wire is not None else "off"
-
-        def send_to(peer: PeerID, flags: Flags = Flags.NONE) -> None:
-            # zero-copy: the walk's phases are sequential per chunk, so the
-            # buffer cannot be mutated while sendall drains it
-            self.client.send(
-                peer, w.name, _buf(effective()), ConnType.COLLECTIVE, flags
-            )
-            self._count_wire(wire_nbytes, wire_label, codec_label, nbytes)
-
-        def send_all(peers: List[PeerID], flags: Flags = Flags.NONE) -> None:
-            """Fan-out send of the current effective() buffer. Wire mode
-            encodes ONCE into a shared scratch for the whole fan-out —
-            every edge carries identical bytes, so per-peer encodes (a
-            full payload pass each) would be pure waste at STAR/CLIQUE
-            fan-outs. The scratch returns to the pool only on success:
-            after a timeout an abandoned send thread may still be
-            draining it."""
-            if not peers:
-                return
-            if wire is None:
-                _t_send = time.perf_counter()
-                _par([lambda p=p: send_to(p, flags) for p in peers],
-                     self.timeout, cancel)
-                if prof is not None:
-                    prof.send += time.perf_counter() - _t_send
-                return
-            scratch = bufpool.get(wire_nbytes)
-            enc = np.frombuffer(scratch, np.uint16, w.recv.size)
-            # the fan-out encode is codec COMPUTE (the residual bucket),
-            # so only the transport fan-out below is timed as send
-            encode_wire(enc, effective(), wire)
-
-            def send_enc(peer: PeerID) -> None:
-                self.client.send(
-                    peer, w.name, _buf(enc), ConnType.COLLECTIVE, flags
-                )
-                self._count_wire(wire_nbytes, wire_label, codec_label, nbytes)
-
-            _t_send = time.perf_counter()
-            _par([lambda p=p: send_enc(p) for p in peers], self.timeout, cancel)
-            if prof is not None:
-                prof.send += time.perf_counter() - _t_send
-            bufpool.put(scratch)
-
-        bufpool = get_buffer_pool()
-        nbytes = w.recv.size * w.recv.itemsize
-        wire_nbytes = w.recv.size * 2 if wire is not None else nbytes
-        recv_dtype = np.dtype(np.uint16) if wire is not None else w.send.dtype
-
-        def recv_payload(peer: PeerID):
-            """See _recv_collective (shared with the segmented walk)."""
-            return self._recv_collective(
-                peer, w.name, wire_nbytes, recv_dtype, w.recv.size, self.timeout
-            )
-
-        def recv_onto(peer: PeerID) -> None:
-            incoming, scratch, release = recv_payload(peer)
-            try:
-                with lock:
-                    if cancel.is_set():
-                        # abort the whole walk: a late arrival must neither
-                        # write the workspace nor let the send phase relay
-                        # stale data
-                        raise TimeoutError(f"collective cancelled: {w.name}")
-                    if wire is not None:
-                        if state["recv_count"] == 0 and not w.is_inplace:
-                            # first arrival: recv = decode(incoming), then
-                            # fold own send in f32 (ops are commutative)
-                            decode_wire(w.recv, incoming, wire)
-                            reduce_inplace(w.recv, w.send, w.op)
-                        else:
-                            decode_accumulate(
-                                w.recv, 0, w.recv.size, incoming, wire, w.op
-                            )
-                    elif state["recv_count"] == 0 and not w.is_inplace:
-                        # first arrival: recv = send (op) incoming
-                        from kungfu_tpu.base.ops import transform2
-
-                        transform2(w.recv, w.send, incoming, w.op)
-                    else:
-                        reduce_inplace(w.recv, incoming, w.op)
-                    state["recv_count"] += 1
-            finally:
-                del incoming
-                if release is not None:
-                    release()
-            if scratch is not None:
-                bufpool.put(scratch)
-
-        def recv_all_onto(peers: List[PeerID]) -> None:
-            """Accumulate phase: receive every prev, then reduce them all
-            in ONE n-ary pass (kf_transform_n). Pairwise-on-arrival
-            overlaps receive with reduce, which pays when cores are free;
-            the n-ary pass minimizes memory traffic, which wins outright
-            on busy/low-core hosts — and the receives themselves still
-            overlap each other."""
-            got: List = [None] * len(peers)
-
-            def grab(i: int, p: PeerID) -> None:
-                res = recv_payload(p)
-                if cancel.is_set():
-                    # the walk already timed out and its finally block may
-                    # have run: release the borrow here or nobody will
-                    if res[2] is not None:
-                        res[2]()
-                    return
-                got[i] = res
-
-            try:
-                _t_recv = time.perf_counter()
-                _par(
-                    [lambda i=i, p=p: grab(i, p) for i, p in enumerate(peers)],
-                    self.timeout,
-                    cancel,
-                )
-                if prof is not None:
-                    prof.wait += time.perf_counter() - _t_recv
-                with lock:
-                    if cancel.is_set():
-                        raise TimeoutError(f"collective cancelled: {w.name}")
-                    if wire is not None:
-                        # decode-accumulate each arrival into f32 (the
-                        # fused kernel; no n-ary variant exists for mixed
-                        # wire/f32 sources and the tree fan-in is small)
-                        if not w.is_inplace:
-                            w.forward()
-                        for incoming, _, _ in got:
-                            decode_accumulate(
-                                w.recv, 0, w.recv.size, incoming, wire, w.op
-                            )
-                    elif w.is_inplace:
-                        for incoming, _, _ in got:
-                            reduce_inplace(w.recv, incoming, w.op)
-                    else:
-                        transform_n(
-                            w.recv,
-                            [w.send] + [inc for inc, _, _ in got],
-                            w.op,
-                        )
-                    state["recv_count"] += len(peers)
-            finally:
-                for item in got:
-                    if item is not None and item[2] is not None:
-                        item[2]()
-            for item in got:
-                if item is not None and item[1] is not None:
-                    bufpool.put(item[1])
-
-        def recv_into(peer: PeerID) -> None:
-            incoming, scratch, release = recv_payload(peer)
-            try:
-                with lock:
-                    if cancel.is_set():
-                        raise TimeoutError(f"collective cancelled: {w.name}")
-                    if wire is not None:
-                        decode_wire(w.recv, incoming, wire)
-                    else:
-                        np.copyto(w.recv, incoming)
-                    state["recv_count"] += 1
-            finally:
-                del incoming
-                if release is not None:
-                    release()
-            if scratch is not None:
-                bufpool.put(scratch)
-
-        for g in graphs:
-            prevs = [self.peers[r] for r in g.prevs(self.rank)]
-            nexts = [self.peers[r] for r in g.nexts(self.rank)]
-            if g.is_self_loop(self.rank):
-                # accumulate: receive from all prevs, n-ary reduce, send on
-                if prevs and state["recv_count"] == 0:
-                    recv_all_onto(prevs)
-                elif prevs:
-                    # pairwise path: the pool threads fold their reduce
-                    # into this timed block (profiler caveat, see
-                    # WalkProfiler) — receives dominate it
-                    _t_recv = time.perf_counter()
-                    _par([lambda p=p: recv_onto(p) for p in prevs], self.timeout, cancel)
-                    if prof is not None:
-                        prof.wait += time.perf_counter() - _t_recv
-                send_all(nexts)
-            else:
-                # pass-through node: take value from single prev (or forward
-                # own), relay to nexts
-                if not prevs and state["recv_count"] == 0:
-                    w.forward()
-                else:
-                    _t_recv = time.perf_counter()
-                    for p in prevs:
-                        recv_into(p)
-                    if prof is not None:
-                        prof.wait += time.perf_counter() - _t_recv
-                send_all(nexts, Flags.WAIT_RECV_BUF)
-        if wire is not None and not graphs[-1].prevs(self.rank):
-            # the bcast root never receives a wire message, so it would
-            # keep its full-precision f32 result while every other peer
-            # decodes the quantized broadcast: roundtrip the root's recv
-            # through the codec so all peers land on bit-identical values
-            scratch = bufpool.get(wire_nbytes)
-            enc = np.frombuffer(scratch, np.uint16, w.recv.size)
-            encode_wire(enc, w.recv, wire)
-            decode_wire(w.recv, enc, wire)
-            bufpool.put(scratch)
-        wall = time.perf_counter() - _t_walk
-        trace.record(f"host.walk[{w.recv.nbytes >> 20}MiB]", wall)
-        if prof is not None:
-            # graph walks fan out over many edges: score against the
-            # slowest estimated link overall (dsts=None)
-            self._record_walk(wire_label, self.size, w.recv.nbytes, wall, prof)

@@ -1,0 +1,600 @@
+"""Async collective scheduler: readiness-ordered, backprop-overlapped
+group allreduce (ISSUE 10 tentpole).
+
+The synchronous step loop launches `group_all_reduce` at step end, so
+the engine idles through the whole backprop and then burns a serial
+walk (BENCH_HOST_r06/r07: the bert walk's 43s→27s came entirely from
+engine work, none from overlap). The reference's L4 NCCL scheduler
+(PAPER.md §1) orders collectives by gradient readiness and overlaps
+them with backprop; arXiv:1810.11112 measures that overlap as the
+dominant scale lever. This is the host-plane equivalent:
+
+- callers :meth:`~CollectiveScheduler.submit` one workspace per tensor
+  as its gradient becomes ready and :meth:`~CollectiveScheduler.flush`
+  once per step;
+- a background launcher assembles the SAME deterministic buckets the
+  fused pipeline builds (pipeline.py `_make_buckets`, driven by
+  ``KF_CONFIG_GROUP_BUCKET_BYTES``/``KF_CONFIG_GROUP_FUSE_MIN``) and
+  launches each bucket's pack → walk → unpack as soon as its members
+  arrived — while the caller is still producing later gradients.
+
+**Ordering guarantee.** Readiness order is local (peers' backprops
+interleave differently), but peers must walk identical bucket
+sequences. So the launch order is negotiated ONCE per session epoch:
+the first round's submission order (shaped by the optional ``priority``
+argument) becomes the **registered tensor order**, the bucket plan is
+derived from it exactly like the synchronous path, and a consensus
+assert (the `check_knob_consensus` machinery: `_bytes_agree` over the
+knob-independent star walk) verifies every peer registered the
+identical ordered set — a diverging peer raises a named RuntimeError
+instead of deadlocking on mismatched rendezvous names. After
+registration, submissions may arrive in ANY order; buckets launch in
+registered order as they complete, with walk names stamped by a round
+counter so back-to-back rounds can never collide on the wire.
+
+**Results are bit-identical to the synchronous path**: same bucket
+membership, same pack layout, same walk engine, same unpack — only the
+launch *time* moves (asserted by tests/test_scheduler.py at
+np ∈ {2,3,4} on exact payloads under out-of-order submission).
+
+**Epoch lifecycle.** The scheduler lives exactly as long as its
+session: `Peer._update_to` calls `HostSession.close()` before swapping
+sessions, which drains in-flight buckets (bounded) and cancels the
+rest, so nothing from the old epoch keeps walking — or writing caller
+buffers — once the new session exists. Adaptive votes apply at bucket
+boundaries by construction: walks launch one at a time from the walker
+thread and re-read the active (strategy, wire) candidate per workspace,
+and every vote runs at a step boundary (after `flush()`), when no
+bucket is in flight.
+
+Telemetry: `kungfu_scheduler_queued_buckets` /
+`kungfu_scheduler_overlap_seconds_total` /
+`kungfu_scheduler_flush_wait_seconds` plus `sched.pack` / `sched.walk`
+/ `sched.unpack` / `sched.flush` spans (docs/telemetry.md).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, List, Optional, Tuple
+
+import numpy as np
+
+from kungfu_tpu import knobs
+from kungfu_tpu.base.workspace import Workspace
+from kungfu_tpu.telemetry import config as tconfig
+from kungfu_tpu.telemetry import metrics as tmetrics
+from kungfu_tpu.utils import trace
+from kungfu_tpu.utils.handoff import HandoffQueue
+from kungfu_tpu.utils.stall import stall_detect
+
+# kfcheck KF303: every thread this module starts must be declared here
+# (the abort-protocol joinable set) — close() joins exactly these, so a
+# future stage cannot silently outlive a session epoch.
+_KF_JOINABLE_THREADS = ("kf-sched-launch", "kf-sched-walk", "kf-sched-unpack")
+
+# registered-tensor identity: rendezvous-relevant properties only (the
+# consensus digest is built from these, so any cross-peer divergence in
+# name, length, dtype or op is caught at registration)
+_Key = Tuple[str, int, str, int]
+
+
+def _key_of(w: Workspace) -> _Key:
+    return (w.name, int(w.send.size), w.send.dtype.str, int(w.op))
+
+
+class SchedulerClosed(RuntimeError):
+    """Raised by submit/flush after the session epoch ended (resize or
+    explicit close): the caller must fetch the NEW session's scheduler."""
+
+
+class _Unit:
+    """One launch unit of the negotiated plan: a fused bucket (>= the
+    fusion threshold, same dtype/op, <= the bucket byte cap) or a single
+    workspace. Derived purely from the registered order and the
+    cluster-agreed knobs, so every peer computes the identical plan."""
+
+    __slots__ = ("index", "keys", "fused")
+
+    def __init__(self, index: int, keys: List[_Key], fused: bool):
+        self.index = index
+        self.keys = keys
+        self.fused = fused
+
+
+class CollectiveScheduler:
+    """Per-session background scheduler for asynchronous group
+    allreduce. Thread-safe submit; one flush caller per round."""
+
+    def __init__(self, sess):
+        self.sess = sess
+        self.queue_depth = max(1, int(knobs.get("KF_CONFIG_ASYNC_QUEUE")))
+        self._cond = threading.Condition()
+        self._abort = threading.Event()
+        self._errors: List[BaseException] = []
+        self._closed = False
+        # registration (per session epoch, negotiated at first flush)
+        self._registry: Optional[List[_Key]] = None
+        self._known: set = set()
+        self._plan: List[_Unit] = []
+        self._first_round: List[Tuple[int, int, Workspace]] = []  # (prio, seq, w)
+        # per-round state (all under _cond)
+        self._round = 0
+        self._pending: Dict[_Key, Workspace] = {}
+        self._submitted: set = set()
+        self._next_unit = 0
+        self._completed = 0
+        self._busy_s = 0.0  # pack+walk+unpack seconds this round
+        self._queued = 0  # units packed but not yet unpacked (gauge)
+        # lifetime stats (for the bench OVERLAP report)
+        self._stat = {
+            "rounds": 0, "units": 0, "buckets": 0,
+            "flush_wait_s": 0.0, "busy_s": 0.0, "overlap_s": 0.0,
+        }
+        self._threads: List[threading.Thread] = []
+        self._walkq = HandoffQueue(maxsize=self.queue_depth, abort=self._abort)
+        self._unpackq = HandoffQueue(maxsize=1, abort=self._abort)
+        if tconfig.metrics_enabled():
+            self._queued_gauge = tmetrics.gauge(
+                "kungfu_scheduler_queued_buckets",
+                "Async-scheduler launch units currently packed or "
+                "walking (not yet unpacked)",
+            )
+            self._overlap_ctr = tmetrics.counter(
+                "kungfu_scheduler_overlap_seconds_total",
+                "Scheduler engine-busy seconds that overlapped caller "
+                "compute (busy time minus flush wait, per round)",
+            )
+            self._flush_wait_ctr = tmetrics.counter(
+                "kungfu_scheduler_flush_wait_seconds",
+                "Seconds flush() blocked waiting for in-flight buckets",
+            )
+        else:
+            self._queued_gauge = None
+            self._overlap_ctr = None
+            self._flush_wait_ctr = None
+
+    # ------------------------------------------------------------------
+    # public API
+    # ------------------------------------------------------------------
+
+    def submit(self, w: Workspace, priority: Optional[int] = None) -> None:
+        """Hand one tensor's workspace to the scheduler as it becomes
+        ready. Thread-safe; returns immediately (the walk happens on the
+        scheduler threads). `w.recv` must stay valid until the round's
+        `flush()` returns, and `w.name` must be STABLE across rounds —
+        it is this tensor's registered identity (the scheduler stamps
+        its own round counter into wire names).
+
+        `priority` shapes the negotiated launch order during the FIRST
+        round only (lower launches earlier, default = arrival order);
+        after registration the cluster-wide registered order governs and
+        the argument is ignored."""
+        if w.is_empty:
+            return
+        key = _key_of(w)
+        with self._cond:
+            self._raise_if_dead_locked()
+            if self._registry is None:
+                seq = len(self._first_round)
+                prio = seq if priority is None else int(priority)
+                self._first_round.append((prio, seq, w))
+                return
+            if key not in self._known:
+                raise ValueError(
+                    f"submit of unregistered tensor {key[0]!r} "
+                    f"(size={key[1]}, dtype={key[2]}, op={key[3]}) — the "
+                    "registered set is negotiated at the first flush and "
+                    "fixed for the session epoch; resize to change it"
+                )
+            if key in self._submitted:
+                raise ValueError(
+                    f"tensor {key[0]!r} submitted twice in round "
+                    f"{self._round} — call flush() between rounds"
+                )
+            self._submitted.add(key)
+            self._pending[key] = w
+            self._cond.notify_all()
+
+    def flush(self, timeout: Optional[float] = None) -> None:
+        """Block until every workspace submitted this round has been
+        reduced and scattered back (`w.recv` holds the result), then
+        advance the round. Re-raises the scheduler's REAL error (walk
+        failure, abort) if one occurred. The first flush of a session
+        epoch performs the registration handshake (see module doc)."""
+        t0 = time.perf_counter()
+        with trace.span("sched.flush"), stall_detect("scheduler.flush"):
+            with self._cond:
+                if self._registry is None and not self._first_round:
+                    # nothing was ever submitted: a defensive flush must
+                    # NOT register an empty set (that would freeze the
+                    # epoch's registry as {} and poison every later
+                    # submit) — true no-op
+                    return
+                if self._registry is not None and not self._submitted:
+                    # clean round boundary, zero submissions: no-op —
+                    # "every registered tensor exactly once per round"
+                    # applies to rounds, and an empty flush isn't one
+                    return
+            if self._registry is None:
+                self._register()
+            with self._cond:
+                # a dead scheduler reports its REAL state (error /
+                # closed epoch) before complaining about round shape
+                self._raise_if_dead_locked()
+                missing = self._known - self._submitted
+                if missing:
+                    names = sorted(k[0] for k in missing)[:8]
+                    raise RuntimeError(
+                        f"flush() with {len(missing)} registered tensors "
+                        f"not submitted this round (e.g. {names}) — every "
+                        "registered tensor must be submitted exactly once "
+                        "per round"
+                    )
+            if timeout is None:
+                timeout = self.sess.timeout * max(1, len(self._plan))
+            deadline = time.monotonic() + timeout
+            with self._cond:
+                while True:
+                    if self._errors:
+                        raise self._errors[0]
+                    if self._closed:
+                        raise SchedulerClosed(
+                            "collective scheduler closed (session epoch "
+                            "ended) during flush"
+                        )
+                    if self._completed >= len(self._plan):
+                        break
+                    if time.monotonic() >= deadline:
+                        self._abort.set()
+                        raise TimeoutError(
+                            f"scheduler flush timed out: "
+                            f"{self._completed}/{len(self._plan)} units "
+                            f"done in round {self._round}"
+                        )
+                    self._cond.wait(0.2)
+                # advance the round
+                wait = time.perf_counter() - t0
+                busy = self._busy_s
+                self._round += 1
+                self._pending.clear()
+                self._submitted.clear()
+                self._next_unit = 0
+                self._completed = 0
+                self._busy_s = 0.0
+                self._stat["rounds"] += 1
+                self._stat["flush_wait_s"] += wait
+                self._stat["busy_s"] += busy
+                self._stat["overlap_s"] += max(0.0, busy - wait)
+                self._cond.notify_all()
+        if self._flush_wait_ctr is not None:
+            self._flush_wait_ctr.inc(wait)
+        if self._overlap_ctr is not None:
+            self._overlap_ctr.inc(max(0.0, busy - wait))
+
+    def round_index(self) -> int:
+        """The current (not-yet-flushed) round number. A submission
+        made now belongs to this round; pair it with
+        :meth:`flush_round`."""
+        with self._cond:
+            return self._round
+
+    def flush_round(self, round_index: Optional[int],
+                    timeout: Optional[float] = None) -> None:
+        """Flush only if round `round_index` has not been flushed yet —
+        the idempotent form behind AsyncGroupResult.wait(): several
+        handles of one round each call this, the first flushes, the
+        rest observe the advanced round and return. `None` flushes
+        unconditionally."""
+        if round_index is not None:
+            with self._cond:
+                if self._round > round_index:
+                    return
+        self.flush(timeout=timeout)
+
+    def stats(self) -> dict:
+        """Lifetime scheduler stats (bench OVERLAP report): rounds,
+        units/buckets walked, flush-wait vs engine-busy seconds and the
+        overlapped share."""
+        with self._cond:
+            out = dict(self._stat)
+        busy = out["busy_s"]
+        out["overlap_frac"] = out["overlap_s"] / busy if busy > 0 else 0.0
+        return out
+
+    def close(self, timeout: float = 30.0) -> None:
+        """End the scheduler: drain in-flight units (bounded by
+        `timeout`), cancel everything not yet launched, join the worker
+        threads. Idempotent; called by `HostSession.close()` on every
+        session swap (elastic resize) and at peer stop. Pending
+        workspaces that never launched are dropped — the new epoch's
+        caller resubmits against the new session."""
+        with self._cond:
+            if self._closed:
+                started = False
+            else:
+                self._closed = True
+                started = bool(self._threads)
+            self._cond.notify_all()
+        if not started:
+            return
+        deadline = time.monotonic() + max(1.0, timeout)
+        for t in self._threads:
+            t.join(max(0.1, deadline - time.monotonic()))
+        if any(t.is_alive() for t in self._threads):
+            # drain exceeded its budget: hard-cancel (in-flight walks
+            # observe the abort before mutating caller buffers) and give
+            # the threads a short grace to unwind
+            self._abort.set()
+            for t in self._threads:
+                t.join(5.0)
+        with self._cond:
+            self._cond.notify_all()
+
+    # ------------------------------------------------------------------
+    # registration (once per session epoch)
+    # ------------------------------------------------------------------
+
+    def _register(self) -> None:
+        """First flush: freeze the submission order into the registered
+        tensor order, consensus-assert it across peers, derive the
+        bucket plan, and start the worker threads."""
+        with self._cond:
+            self._raise_if_dead_locked()
+            if self._registry is not None:
+                return
+            snapshot = list(self._first_round)
+            entries = sorted(snapshot, key=lambda e: (e[0], e[1]))
+            registry = [_key_of(w) for _, _, w in entries]
+            if len(set(registry)) != len(registry):
+                dupes = sorted(
+                    {k[0] for k in registry if registry.count(k) > 1}
+                )[:4]
+                raise ValueError(
+                    f"duplicate tensors in first round: {dupes} — "
+                    "registered names must be unique"
+                )
+        # consensus OUTSIDE the lock: this runs real collectives on the
+        # knob-independent star walk (check_knob_consensus machinery) —
+        # the walk must not serialize behind the scheduler's own lock
+        digest = ";".join(
+            f"{n}:{s}:{d}:{o}" for n, s, d, o in registry
+        ).encode()
+        if not self.sess._bytes_agree(
+            digest, ":sched:registry", self.sess._fixed_allreduce
+        ):
+            raise RuntimeError(
+                "async scheduler registration diverged across peers: the "
+                "first round's (name, size, dtype, op) submission order "
+                "must be identical cluster-wide — it becomes the "
+                "negotiated launch order (check tensor naming and "
+                "per-rank model divergence)"
+            )
+        plan = self._build_plan(registry)
+        known = set(registry)
+        with self._cond:
+            # validate EVERYTHING before committing any state: raising
+            # after self._registry is set but before the threads start
+            # would leave a registered scheduler whose flush() waits on
+            # workers that do not exist. Submissions that raced into the
+            # (unlocked) consensus window are checked against the
+            # registry they were not part of — a silently dropped
+            # tensor would leave stale recv data behind a clean flush.
+            pending: Dict[_Key, Workspace] = {}
+            submitted: set = set()
+            for _, _, w in snapshot:
+                pending[_key_of(w)] = w
+                submitted.add(_key_of(w))
+            for _, _, w in self._first_round[len(snapshot):]:
+                key = _key_of(w)
+                if key not in known:
+                    raise ValueError(
+                        f"tensor {key[0]!r} submitted during the "
+                        "registration handshake but absent from the "
+                        "negotiated set — quiesce submissions around "
+                        "the first flush()"
+                    )
+                if key in submitted:
+                    raise ValueError(
+                        f"tensor {key[0]!r} submitted twice in the "
+                        "registration round"
+                    )
+                pending[key] = w
+                submitted.add(key)
+            self._registry = registry
+            self._known = known
+            self._plan = plan
+            self._pending.update(pending)
+            self._submitted |= submitted
+            self._first_round.clear()
+            self._start_threads_locked()
+            self._cond.notify_all()
+
+    def _build_plan(self, registry: List[_Key]) -> List[_Unit]:
+        """The synchronous path's grouping, expressed over registered
+        indices: same-(dtype, op) runs of >= FUSE_MIN_TENSORS fuse into
+        <= GROUP_BUCKET_BYTES buckets (pipeline._make_buckets' greedy
+        order-preserving packing); smaller groups launch as singles.
+        Pure function of (registry, cluster-agreed knobs) — every peer
+        derives the identical plan from the consensus-checked registry."""
+        sess = self.sess
+        groups: Dict[Tuple[str, int], List[_Key]] = {}
+        for key in registry:
+            groups.setdefault((key[2], key[3]), []).append(key)
+        units: List[_Unit] = []
+        singles: List[_Key] = []
+        for members in groups.values():
+            if len(members) < sess.FUSE_MIN_TENSORS:
+                singles.extend(members)
+                continue
+            # greedy order-preserving byte-cap packing (mirrors
+            # pipeline._make_buckets, over keys instead of workspaces)
+            cur: List[_Key] = []
+            cur_bytes = 0
+            isize = np.dtype(members[0][2]).itemsize
+            for key in members:
+                nbytes = key[1] * isize
+                if cur and cur_bytes + nbytes > sess.GROUP_BUCKET_BYTES:
+                    units.append(_Unit(len(units), cur, fused=True))
+                    cur, cur_bytes = [], 0
+                cur.append(key)
+                cur_bytes += nbytes
+            if cur:
+                units.append(_Unit(len(units), cur, fused=True))
+        for key in singles:
+            units.append(_Unit(len(units), [key], fused=False))
+        return units
+
+    # ------------------------------------------------------------------
+    # worker threads (the KF303 joinable set)
+    # ------------------------------------------------------------------
+
+    def _start_threads_locked(self) -> None:
+        self._spawn_registered("kf-sched-launch", self._launch_loop)
+        self._spawn_registered("kf-sched-walk", self._walk_loop)
+        self._spawn_registered("kf-sched-unpack", self._unpack_loop)
+
+    def _spawn_registered(self, name: str, target) -> None:
+        """The ONLY place this module may construct a thread (kfcheck
+        KF303): the name must be declared in `_KF_JOINABLE_THREADS` and
+        the thread lands in `self._threads`, which `close()` joins — so
+        a future stage cannot silently outlive the session epoch."""
+        t = threading.Thread(target=target, name=name, daemon=True)
+        self._threads.append(t)
+        t.start()
+
+    def _record_error(self, e: BaseException) -> None:
+        with self._cond:
+            self._errors.append(e)
+            self._cond.notify_all()
+        self._abort.set()
+
+    def _raise_if_dead_locked(self) -> None:
+        if self._errors:
+            raise self._errors[0]
+        if self._closed:
+            raise SchedulerClosed(
+                "collective scheduler closed (session epoch ended) — "
+                "fetch the current session's scheduler and resubmit"
+            )
+
+    def _claim_next(self):
+        """Launcher: block until the next unit in plan order has all its
+        members submitted; returns (unit, members) or None to exit
+        (close/abort). Launch STRICTLY in registered order — that is the
+        cross-peer determinism contract."""
+        with self._cond:
+            while True:
+                if self._abort.is_set():
+                    return None
+                if self._closed:
+                    # drain semantics: stop LAUNCHING; in-flight units
+                    # finish downstream
+                    return None
+                if self._next_unit < len(self._plan):
+                    unit = self._plan[self._next_unit]
+                    if all(k in self._pending for k in unit.keys):
+                        self._next_unit += 1
+                        members = [self._pending.pop(k) for k in unit.keys]
+                        return unit, members, self._round
+                self._cond.wait(0.2)
+
+    def _launch_loop(self) -> None:
+        try:
+            while True:
+                claimed = self._claim_next()
+                if claimed is None:
+                    return
+                unit, members, rnd = claimed
+                t0 = time.perf_counter()
+                if unit.fused:
+                    with trace.span("sched.pack", unit=unit.index):
+                        # round-stamped fused name: back-to-back rounds
+                        # must not collide on the wire (a fast peer's
+                        # round r+1 sends must never be consumed by a
+                        # slow peer still walking round r)
+                        item = self.sess._pack_bucket(
+                            unit.index, members, name_prefix=f"r{rnd}:"
+                        )
+                else:
+                    w = members[0]
+                    item = (
+                        Workspace(
+                            send=w.send, recv=w.recv, op=w.op,
+                            name=f"{w.name}::as:r{rnd}",
+                        ),
+                        None, None, members,
+                    )
+                self._add_busy(time.perf_counter() - t0, queued=+1)
+                if not self._walkq.put((unit, item)):
+                    return  # aborted while the queue was full
+        except BaseException as e:  # noqa: BLE001 - channeled to flush()
+            self._record_error(e)
+        finally:
+            self._walkq.put(None)
+
+    def _walk_loop(self) -> None:
+        try:
+            while True:
+                got = self._walkq.get()
+                if got is None:
+                    return
+                if self._abort.is_set():
+                    continue  # drain to the sentinel
+                unit, item = got
+                t0 = time.perf_counter()
+                with trace.span("sched.walk", unit=unit.index):
+                    if unit.fused:
+                        deferred = self.sess._allreduce_ws(
+                            item[0], cancel=self._abort, defer_decode=True
+                        )
+                    else:
+                        self.sess._allreduce_ws(item[0], cancel=self._abort)
+                        deferred = None
+                self._add_busy(time.perf_counter() - t0)
+                if not self._unpackq.put((unit, item + (deferred,))):
+                    return
+        except BaseException as e:  # noqa: BLE001 - channeled to flush()
+            self._record_error(e)
+        finally:
+            self._unpackq.put(None)
+
+    def _unpack_loop(self) -> None:
+        try:
+            while True:
+                got = self._unpackq.get()
+                if got is None:
+                    return
+                if self._abort.is_set():
+                    continue  # aborted: must not touch caller buffers
+                unit, item = got
+                t0 = time.perf_counter()
+                if unit.fused:
+                    with trace.span("sched.unpack", unit=unit.index):
+                        self.sess._unpack_bucket(item)
+                else:
+                    # single: the walk wrote w.recv in place (the
+                    # wrapper workspace shares the caller's buffers);
+                    # nothing to scatter
+                    deferred = item[4]
+                    if deferred is not None:
+                        deferred.close()
+                self._add_busy(time.perf_counter() - t0, queued=-1)
+                with self._cond:
+                    self._completed += 1
+                    self._stat["units"] += 1
+                    if unit.fused:
+                        self._stat["buckets"] += 1
+                    self._cond.notify_all()
+        except BaseException as e:  # noqa: BLE001 - channeled to flush()
+            self._record_error(e)
+
+    def _add_busy(self, seconds: float, queued: int = 0) -> None:
+        with self._cond:
+            self._busy_s += seconds
+            if queued:
+                self._queued += queued
+            q = self._queued
+        if queued and self._queued_gauge is not None:
+            self._queued_gauge.set(q)

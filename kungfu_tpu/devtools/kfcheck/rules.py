@@ -582,6 +582,127 @@ def check_unbounded_join(ctx: FileContext) -> List[Finding]:
     return out
 
 
+# the modules that run background stages against a session epoch: their
+# threads MUST register with the abort protocol (a declared joinable
+# set that close() joins), or a forgotten stage outlives the epoch and
+# keeps walking against a dead transport token
+_KF303_MODULES = (
+    "kungfu_tpu/collective/scheduler.py",
+    "kungfu_tpu/collective/pipeline.py",
+)
+
+_KF303_FACTORY = "_spawn_registered"
+
+
+def _declared_joinable_threads(ctx: FileContext) -> Optional[List[str]]:
+    """The module-level `_KF_JOINABLE_THREADS` tuple of thread names, or
+    None when the module declares none."""
+    if ctx.tree is None:
+        return None
+    for node in ctx.tree.body:
+        if (
+            isinstance(node, ast.Assign)
+            and len(node.targets) == 1
+            and isinstance(node.targets[0], ast.Name)
+            and node.targets[0].id == "_KF_JOINABLE_THREADS"
+            and isinstance(node.value, (ast.Tuple, ast.List))
+        ):
+            return [
+                e.value for e in node.value.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)
+            ]
+    return None
+
+
+class _ThreadSiteWalker(ast.NodeVisitor):
+    """Collects (enclosing function name, Thread-ctor node) pairs and
+    every `*._spawn_registered(...)` call in one file."""
+
+    def __init__(self):
+        self.func_stack: List[str] = []
+        self.ctors: List[Tuple[Optional[str], ast.Call]] = []
+        self.spawns: List[ast.Call] = []
+
+    def visit_FunctionDef(self, node: ast.FunctionDef) -> None:
+        self.func_stack.append(node.name)
+        self.generic_visit(node)
+        self.func_stack.pop()
+
+    visit_AsyncFunctionDef = visit_FunctionDef
+
+    def visit_Call(self, node: ast.Call) -> None:
+        if _is_thread_ctor(node):
+            enclosing = self.func_stack[-1] if self.func_stack else None
+            self.ctors.append((enclosing, node))
+        if _last_segment(node.func) == _KF303_FACTORY:
+            self.spawns.append(node)
+        self.generic_visit(node)
+
+
+@rule(
+    "KF303",
+    "unregistered-scheduler-thread",
+    "threads started by the collective scheduler/pipeline modules must "
+    "register with the abort protocol: constructed only inside the "
+    "_spawn_registered factory, spawned with a literal name declared in "
+    "the module-level _KF_JOINABLE_THREADS joinable-set (close() joins "
+    "exactly that set), so a future stage cannot silently outlive a "
+    "session epoch",
+)
+def check_scheduler_threads(ctx: FileContext) -> List[Finding]:
+    if ctx.relpath not in _KF303_MODULES or ctx.tree is None:
+        return []
+    w = _ThreadSiteWalker()
+    w.visit(ctx.tree)
+    declared = _declared_joinable_threads(ctx)
+    out: List[Finding] = []
+    if (w.ctors or w.spawns) and declared is None:
+        first = w.ctors[0][1] if w.ctors else w.spawns[0]
+        out.append(Finding(
+            "KF303", ctx.relpath, first.lineno,
+            "this module starts threads but declares no "
+            "_KF_JOINABLE_THREADS joinable-set — declare the thread "
+            "names at module level so close() provably joins them all",
+        ))
+        declared = []
+    for enclosing, node in w.ctors:
+        if enclosing != _KF303_FACTORY:
+            out.append(Finding(
+                "KF303", ctx.relpath, node.lineno,
+                f"threading.Thread constructed outside {_KF303_FACTORY} "
+                "— scheduler/pipeline threads must go through the "
+                "registering factory (named, declared, tracked for "
+                "close() to join)",
+            ))
+    used: Set[str] = set()
+    for node in w.spawns:
+        arg0 = node.args[0] if node.args else None
+        if not (isinstance(arg0, ast.Constant) and isinstance(arg0.value, str)):
+            out.append(Finding(
+                "KF303", ctx.relpath, node.lineno,
+                f"{_KF303_FACTORY} must be called with a literal thread "
+                "name (the declared joinable-set is matched statically)",
+            ))
+            continue
+        used.add(arg0.value)
+        if declared is not None and arg0.value not in declared:
+            out.append(Finding(
+                "KF303", ctx.relpath, node.lineno,
+                f"thread name {arg0.value!r} is not declared in "
+                "_KF_JOINABLE_THREADS — add it so the joinable-set "
+                "stays the complete inventory",
+            ))
+    for name in declared or []:
+        if name not in used:
+            out.append(Finding(
+                "KF303", ctx.relpath, 1,
+                f"_KF_JOINABLE_THREADS declares {name!r} but no "
+                f"{_KF303_FACTORY} call spawns it — drop the stale "
+                "entry (a rotting inventory hides real leaks)",
+            ))
+    return out
+
+
 # ---------------------------------------------------------------------
 # KF4xx — exception hygiene
 # ---------------------------------------------------------------------

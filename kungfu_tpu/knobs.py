@@ -324,6 +324,22 @@ _knob("KF_CONFIG_GROUP_BUCKET_BYTES", str(64 << 20), _int,
       "Fused-bucket size cap for the 3-stage pack/walk/unpack pipeline. "
       "Cluster-agreed (part of the fused workspace name).",
       section=_SEC_ENGINE, kind="int")
+_knob("KF_CONFIG_ASYNC", "",
+      _choice("KF_CONFIG_ASYNC", ("off", "on", "auto"), empty_as="off"),
+      "Asynchronous collective scheduler: group allreduces submitted "
+      "per-tensor as gradients become ready launch from a background "
+      "thread and overlap backprop (`on`), or only when the session has "
+      "≥2 peers (`auto`). `off` runs the synchronous step-end group op. "
+      "Cluster-agreed: the mode decides the fused rendezvous names, so "
+      "it is checked by `check_knob_consensus` at every session epoch.",
+      section=_SEC_ENGINE, kind="choice", strict=True, default_doc="off")
+_knob("KF_CONFIG_ASYNC_QUEUE", "2", _int,
+      "Async scheduler launch-queue depth: how many packed buckets may "
+      "sit between the pack and walk stages (bounds live pooled staging "
+      "buffers; the walk itself is serialized for cross-peer launch "
+      "determinism). Local-only (not cluster-agreed — it changes no "
+      "rendezvous name, only local overlap).",
+      section=_SEC_ENGINE, kind="int")
 
 _SEC_TRANSPORT = "Transport / shared memory"
 _knob("KF_CONFIG_SHM", "1", lambda s: str(s).strip() != "0",

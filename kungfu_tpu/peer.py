@@ -153,6 +153,9 @@ class Peer:
         self.flight_recorder = flight.start_recorder(peer=str(self.self_id))
 
     def stop(self) -> None:
+        with self._session_lock:
+            if self._session is not None:
+                self._session.close(timeout=5.0)
         self.server.stop()
         self.client.close()
         if getattr(self, "metrics_server", None) is not None:
@@ -183,6 +186,16 @@ class Peer:
         """Rebuild the session for a new peer list; returns False if self is
         not a member (detached). Parity: peer.updateTo (peer.go:148-170)."""
         with self._session_lock:
+            if self._session is not None:
+                # session-epoch invalidation (ISSUE 10): the old epoch's
+                # async scheduler must drain or cancel its in-flight
+                # buckets BEFORE the transport token advances and the
+                # session is replaced — a walk left running would wedge
+                # on fenced messages and could write caller buffers the
+                # new epoch already reuses. Detached peers drain too:
+                # their epoch ended just as finally.
+                with trace.span("resize.drain_scheduler"):
+                    self._session.close(timeout=10.0)
             if peers.rank(self.self_id) is None:
                 self.detached = True
                 return False

@@ -64,13 +64,22 @@ def _to_torch(arr: np.ndarray):
 _sync_round = [0]
 
 
-def sync_gradients(module_or_params, name: str = "torch-grad") -> None:
+def sync_gradients(module_or_params, name: str = "torch-grad",
+                   _force_sync_engine: bool = False) -> None:
     """Average .grad across the cluster in-place (parity:
     _synchronize_grads, kungfu/torch/optimizers.py). One windowed group
     allreduce over the host plane; no-op for a cluster of one. Wire names
     carry a per-process round counter: a peer that finishes round k and
     immediately starts k+1 must not have its sends consumed by a slower
-    peer still waiting on round k."""
+    peer still waiting on round k.
+
+    With the async scheduler enabled (``KF_CONFIG_ASYNC``) the group is
+    routed through it instead (submit-all + flush — grads are already
+    ready here, so there is no backprop overlap; the hook path in
+    SynchronousSGDOptimizer is the overlapped one). Scheduler tensor
+    names must be STABLE across steps, so the trailing ``:<suffix>`` of
+    `name` (the sync path's round counter) is stripped — the scheduler
+    stamps its own round counter into wire names."""
     size = api.cluster_size()
     if size <= 1:
         return
@@ -81,12 +90,25 @@ def sync_gradients(module_or_params, name: str = "torch-grad") -> None:
     _sync_round[0] += 1
     views = [_flat_view(p.grad) for p in params]
     sess = api.get_default_peer().current_session()
-    ws = [
-        Workspace(send=v, recv=v, op=ReduceOp.SUM,
-                  name=f"kungfu::torch:{name}:{rnd}:{i}")
-        for i, v in enumerate(views)
-    ]
-    sess.group_all_reduce(ws)
+    if sess.async_enabled() and not _force_sync_engine:
+        # async scheduler path (ISSUE 10): stable per-tensor names (the
+        # scheduler stamps its own round counter into wire names, which
+        # is what the :{rnd}: component below exists for on the sync
+        # path), submitted in parameter order, one flush per step
+        sched = sess.scheduler()
+        for i, v in enumerate(views):
+            sched.submit(Workspace(
+                send=v, recv=v, op=ReduceOp.SUM,
+                name=f"kungfu::torch:{name.rsplit(':', 1)[0]}:{i}",
+            ))
+        sched.flush()
+    else:
+        ws = [
+            Workspace(send=v, recv=v, op=ReduceOp.SUM,
+                      name=f"kungfu::torch:{name}:{rnd}:{i}")
+            for i, v in enumerate(views)
+        ]
+        sess.group_all_reduce(ws)
     inv = 1.0 / size
     for p, v in zip(params, views):
         v *= v.dtype.type(inv)
@@ -127,18 +149,124 @@ def all_reduce(tensor, op: ReduceOp = ReduceOp.SUM, name: str = "torch-ar"):
 class SynchronousSGDOptimizer:
     """S-SGD wrapper over any torch optimizer (parity:
     SynchronousSGDOptimizer, kungfu/torch/optimizers.py): averages
-    gradients across the cluster, then applies the base step."""
+    gradients across the cluster, then applies the base step.
 
-    def __init__(self, base, name: str = "ssgd"):
+    With the async collective scheduler enabled (``KF_CONFIG_ASYNC``,
+    ISSUE 10) each parameter's gradient is SUBMITTED the moment autograd
+    finishes accumulating it (post-accumulate-grad hooks), so buckets
+    pack and walk while backward is still producing later gradients;
+    ``step()`` then only flushes the tail. Falls back to the step-end
+    group op when the scheduler is off, the cluster is size 1, or torch
+    predates the hook API (<2.1). Results are bit-identical either way
+    (same buckets, same engine — only launch time moves).
+
+    Hook-path contract: exactly ONE backward per ``step()``. Gradient
+    accumulation (several ``backward()`` calls before a step) would
+    submit partially-accumulated gradients, so pass
+    ``async_hooks=False`` to keep the step-end path for such loops (a
+    second backward otherwise fails fast with the scheduler's
+    "submitted twice in round" error rather than reducing partial
+    data)."""
+
+    def __init__(self, base, name: str = "ssgd",
+                 async_hooks: Optional[bool] = None):
         self.base = base
         self.name = name
         self._step = 0
+        self._async_grads: dict = {}  # param index -> (param, flat view)
+        # None: follow the session's KF_CONFIG_ASYNC; False: never hook
+        # (gradient-accumulation loops); True: require hooks or fall
+        # back silently like None
+        self._async_opt_in = async_hooks
+        self._hooks_installed: Optional[bool] = None  # None: undecided
 
-    def step(self, closure=None):
-        params = [
+    def _params_list(self) -> List:
+        return [
             p for group in self.base.param_groups for p in group["params"]
         ]
-        sync_gradients(params, name=f"{self.name}:{self._step}")
+
+    def _install_hooks(self) -> bool:
+        """Register per-param submission hooks when the async scheduler
+        can take them; decided once, at the first step (the session
+        exists by then). Hook firing order is autograd order — identical
+        across data-parallel replicas of the same model, which is what
+        the scheduler's registration consensus verifies."""
+        if self._async_opt_in is False:
+            return False
+        if api.cluster_size() <= 1:
+            return False
+        sess = api.get_default_peer().current_session()
+        if not sess.async_enabled():
+            return False
+        params = self._params_list()
+        if not all(
+            hasattr(p, "register_post_accumulate_grad_hook") for p in params
+        ):
+            return False
+
+        def make_hook(i):
+            def hook(param):
+                s = api.get_default_peer().current_session()
+                if not s.async_enabled():
+                    # an elastic resize landed on an async-off session
+                    # (e.g. KF_CONFIG_ASYNC=auto shrunk to 1 peer):
+                    # hooks must go dormant, NOT buffer into a scheduler
+                    # nobody will ever flush — step() falls back to the
+                    # step-end path when _async_grads stays empty
+                    return
+                v = _flat_view(param.grad)
+                self._async_grads[i] = (param, v)
+                s.scheduler().submit(Workspace(
+                    send=v, recv=v, op=ReduceOp.SUM,
+                    name=f"kungfu::torch:{self.name}:{i}",
+                ))
+            return hook
+
+        for i, p in enumerate(params):
+            if p.requires_grad:
+                p.register_post_accumulate_grad_hook(make_hook(i))
+        return True
+
+    def step(self, closure=None):
+        if self._hooks_installed is None:
+            # decided AFTER the first backward: grads of step 0 already
+            # exist, so step 0 always takes the sync path below and the
+            # hooks start feeding the scheduler from step 1
+            self._hooks_installed = self._install_hooks()
+        if self._async_grads:
+            sess = api.get_default_peer().current_session()
+            if not sess.async_enabled():
+                # a resize landed BETWEEN backward and step: this
+                # step's submissions died with the old epoch and some
+                # in-place gradient views may already be partially
+                # reduced — scaling them would corrupt silently, and
+                # re-reducing could double-sum completed buckets. Fail
+                # loudly; the elastic loop re-runs the step.
+                self._async_grads.clear()
+                raise RuntimeError(
+                    "cluster resized mid-step onto an async-off "
+                    "session; gradients of this step are indeterminate "
+                    "— zero_grad() and re-run the backward"
+                )
+            api.flush_async()
+            inv = 1.0 / api.cluster_size()
+            for _, (p, v) in sorted(self._async_grads.items()):
+                v *= v.dtype.type(inv)
+                # v aliases p.grad's storage for CPU tensors; if torch
+                # had to copy (non-CPU / non-contiguous), write back
+                if p.grad.device.type != "cpu" or not p.grad.is_contiguous():
+                    p.grad.copy_(_to_torch(v).view_as(p.grad))
+            self._async_grads.clear()
+        else:
+            # step-end path (step 0, hooks unavailable, or opted out):
+            # force the classic group engine even when the scheduler is
+            # on — routing THIS call through the scheduler would
+            # register grad-filtered indices while the hooks submit
+            # full-param-list indices, desynchronizing the registered
+            # identity set for any model with frozen params
+            sync_gradients(self._params_list(),
+                           name=f"{self.name}:{self._step}",
+                           _force_sync_engine=True)
         self._step += 1
         return self.base.step(closure)
 

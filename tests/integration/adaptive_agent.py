@@ -28,11 +28,15 @@ def main() -> int:
     payload = np.ones(65536, np.float32) * (rank + 1)
 
     # 1) establish a healthy throughput window on the initial strategy
-    initial = api.active_strategy()
+    # (active_candidate is the codec-qualified display name — a vote may
+    # toggle the codec rather than the graphs, and active_strategy, the
+    # Strategy-typed accessor, would miss that switch)
+    initial = api.active_candidate()
+    assert api.active_strategy() is not None  # no set_tree override yet
     for i in range(10):
         api.monitored_all_reduce_array(payload, name=f"warm{i}")
     assert not api.check_interference(), "clean run must not switch"
-    assert api.active_strategy() == initial
+    assert api.active_candidate() == initial
 
     # 2) inject interference: every send now eats 5ms (a congested DCN link)
     orig_send = peer.client.send
@@ -48,7 +52,7 @@ def main() -> int:
     peer.client.send = orig_send
 
     assert switched, "interference vote must switch the strategy"
-    after = api.active_strategy()
+    after = api.active_candidate()
     assert after != initial, f"strategy unchanged: {after}"
     # every peer must agree on the new strategy
     assert api.consensus(after.encode(), "active-strategy"), "strategy diverged"

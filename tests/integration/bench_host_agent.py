@@ -24,6 +24,8 @@ def main() -> None:
         argv += ["--wire", wire]
     if os.environ.get("KF_BENCH_WIRE_AB", ""):
         argv += ["--wire-ab"]
+    if os.environ.get("KF_BENCH_ASYNC", ""):
+        argv += ["--async"]
     sys.argv = argv
     from kungfu_tpu.benchmarks.__main__ import main as bench_main
 

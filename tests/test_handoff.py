@@ -1,0 +1,173 @@
+"""Unit tests for the shared abort-aware handoff primitives (ISSUE 10
+satellite: `_par`, the fused pipeline's put/get closures and the async
+scheduler's launch queue deduped into kungfu_tpu/utils/handoff.py)."""
+
+import threading
+import time
+
+import pytest
+
+from kungfu_tpu.utils.handoff import HandoffQueue, parallel_run
+
+
+# ---------------------------------------------------------------------------
+# HandoffQueue
+# ---------------------------------------------------------------------------
+
+def test_roundtrip_preserves_order():
+    q = HandoffQueue(maxsize=4)
+    for i in range(4):
+        assert q.put(i)
+    assert [q.get() for _ in range(4)] == [0, 1, 2, 3]
+
+
+def test_bounded_put_blocks_until_consumed():
+    q = HandoffQueue(maxsize=1)
+    assert q.put("a")
+    got = []
+
+    def consumer():
+        time.sleep(0.3)
+        got.append(q.get())
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    t0 = time.monotonic()
+    assert q.put("b")  # must wait for the consumer to drain "a"
+    assert time.monotonic() - t0 >= 0.2
+    t.join(5)
+    assert got == ["a"]
+    assert q.get() == "b"
+
+
+def test_abort_unblocks_full_put():
+    q = HandoffQueue(maxsize=1)
+    assert q.put("a")
+    result = {}
+
+    def producer():
+        result["ok"] = q.put("b")  # queue full, nobody consumes
+
+    t = threading.Thread(target=producer, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive()
+    q.close()
+    t.join(5)
+    assert not t.is_alive()
+    assert result["ok"] is False  # dropped, reported
+
+
+def test_abort_turns_get_into_sentinel():
+    """The lost-sentinel hazard: a producer that died before enqueueing
+    its end-of-stream None must not strand the consumer forever."""
+    q = HandoffQueue()
+    result = {}
+
+    def consumer():
+        result["item"] = q.get()
+
+    t = threading.Thread(target=consumer, daemon=True)
+    t.start()
+    time.sleep(0.1)
+    assert t.is_alive()
+    q.abort.set()
+    t.join(5)
+    assert not t.is_alive()
+    assert result["item"] is None
+
+
+def test_shared_abort_event_aborts_every_queue():
+    abort = threading.Event()
+    q1 = HandoffQueue(abort=abort)
+    q2 = HandoffQueue(abort=abort)
+    q1.close()
+    assert q2.get() is None
+
+
+def test_try_get_times_out():
+    q = HandoffQueue()
+    t0 = time.monotonic()
+    assert q.try_get(0.3) is None
+    dt = time.monotonic() - t0
+    assert 0.2 <= dt < 2.0
+    q.put("x")
+    assert q.try_get(1.0) == "x"
+
+
+def test_items_already_queued_still_drain_after_abort():
+    """Abort stops WAITING, not draining: a consumer must still be able
+    to pull items that made it into the queue (the pipeline drains to
+    its sentinel on abort rather than dropping in-flight buckets on the
+    floor)."""
+    q = HandoffQueue(maxsize=4)
+    q.put(1)
+    q.put(2)
+    q.abort.set()
+    assert q.get() == 1
+    assert q.get() == 2
+    assert q.get() is None  # now empty: sentinel
+
+
+# ---------------------------------------------------------------------------
+# parallel_run
+# ---------------------------------------------------------------------------
+
+def test_parallel_run_runs_all():
+    hits = []
+    lock = threading.Lock()
+
+    def mk(i):
+        def fn():
+            with lock:
+                hits.append(i)
+        return fn
+
+    parallel_run([mk(i) for i in range(8)], timeout=10)
+    assert sorted(hits) == list(range(8))
+
+
+def test_parallel_run_single_runs_inline():
+    tid = {}
+    parallel_run([lambda: tid.setdefault("t", threading.get_ident())], 10)
+    assert tid["t"] == threading.get_ident()
+
+
+def test_parallel_run_empty_is_noop():
+    parallel_run([], timeout=0.001)
+
+
+def test_parallel_run_reraises_first_error():
+    def boom():
+        raise ValueError("real error")
+
+    with pytest.raises(ValueError, match="real error"):
+        parallel_run([boom, lambda: None], timeout=10)
+
+
+def test_parallel_run_timeout_sets_cancel():
+    cancel = threading.Event()
+    release = threading.Event()
+
+    def slow():
+        release.wait(10)
+
+    with pytest.raises(TimeoutError):
+        parallel_run([slow, slow], timeout=0.3, cancel=cancel)
+    assert cancel.is_set()
+    release.set()
+
+
+def test_parallel_run_one_deadline_for_all():
+    """N slow workers share one deadline — the wait is ~timeout, not
+    N*timeout."""
+    release = threading.Event()
+
+    def slow():
+        release.wait(10)
+
+    t0 = time.monotonic()
+    with pytest.raises(TimeoutError):
+        parallel_run([slow] * 4, timeout=0.4)
+    assert time.monotonic() - t0 < 2.0
+    release.set()

@@ -265,6 +265,94 @@ def test_kf301_kf302_unbounded_wait_join():
 
 
 # ---------------------------------------------------------------------
+# KF303 — scheduler/pipeline thread registration (ISSUE 10 satellite)
+# ---------------------------------------------------------------------
+
+_SCHED = "kungfu_tpu/collective/scheduler.py"
+
+
+def test_kf303_only_applies_to_scheduler_pipeline_modules():
+    src = '''
+        import threading
+        def anywhere():
+            threading.Thread(target=x, daemon=True).start()
+    '''
+    assert run_rule(R.check_scheduler_threads, src) == []  # other module
+
+
+def test_kf303_clean_registered_spawn():
+    out = run_rule(R.check_scheduler_threads, '''
+        import threading
+        _KF_JOINABLE_THREADS = ("kf-a", "kf-b")
+        class S:
+            def _start(self):
+                self._spawn_registered("kf-a", self._loop_a)
+                self._spawn_registered("kf-b", self._loop_b)
+            def _spawn_registered(self, name, target):
+                t = threading.Thread(target=target, name=name, daemon=True)
+                self._threads.append(t)
+                t.start()
+    ''', _SCHED)
+    assert out == []
+
+
+def test_kf303_ctor_outside_factory():
+    out = run_rule(R.check_scheduler_threads, '''
+        import threading
+        _KF_JOINABLE_THREADS = ()
+        def sneaky():
+            threading.Thread(target=x, daemon=True).start()
+    ''', _SCHED)
+    assert rule_ids(out) == ["KF303"]
+    assert "_spawn_registered" in out[0].message
+
+
+def test_kf303_missing_declaration():
+    out = run_rule(R.check_scheduler_threads, '''
+        import threading
+        class S:
+            def _spawn_registered(self, name, target):
+                threading.Thread(target=target, name=name, daemon=True).start()
+            def go(self):
+                self._spawn_registered("kf-x", self.loop)
+    ''', _SCHED)
+    # one finding for the missing joinable-set, one for the undeclared name
+    assert rule_ids(out) == ["KF303", "KF303"]
+    assert "_KF_JOINABLE_THREADS" in out[0].message
+
+
+def test_kf303_undeclared_and_nonliteral_names():
+    out = run_rule(R.check_scheduler_threads, '''
+        import threading
+        _KF_JOINABLE_THREADS = ("kf-a",)
+        class S:
+            def _spawn_registered(self, name, target):
+                threading.Thread(target=target, name=name, daemon=True).start()
+            def go(self):
+                self._spawn_registered("kf-a", self.a)      # fine
+                self._spawn_registered("kf-rogue", self.b)  # undeclared
+                self._spawn_registered(f"kf-{x}", self.c)   # non-literal
+    ''', _SCHED)
+    assert rule_ids(out) == ["KF303", "KF303"]
+    assert "kf-rogue" in out[0].message
+    assert "literal" in out[1].message
+
+
+def test_kf303_stale_declared_name():
+    out = run_rule(R.check_scheduler_threads, '''
+        import threading
+        _KF_JOINABLE_THREADS = ("kf-a", "kf-ghost")
+        class S:
+            def _spawn_registered(self, name, target):
+                threading.Thread(target=target, name=name, daemon=True).start()
+            def go(self):
+                self._spawn_registered("kf-a", self.a)
+    ''', _SCHED)
+    assert rule_ids(out) == ["KF303"]
+    assert "kf-ghost" in out[0].message
+
+
+# ---------------------------------------------------------------------
 # KF4xx — exception hygiene
 # ---------------------------------------------------------------------
 

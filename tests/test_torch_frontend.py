@@ -42,12 +42,18 @@ def test_all_reduce_tensor_single():
     assert torch.equal(out, t)
 
 
-def test_torch_e2e_two_workers():
+@pytest.mark.parametrize("async_mode", ["", "on"])
+def test_torch_e2e_two_workers(async_mode):
     """kfrun np=2: broadcast equalizes params, S-SGD keeps them
     bit-identical across ranks with rank-dependent data, PairAveraging
-    contracts divergent models."""
+    contracts divergent models. Parametrized over KF_CONFIG_ASYNC: the
+    "on" leg drives the async scheduler's optimizer step path (ISSUE
+    10) — post-accumulate-grad hooks submit during backward from step 1
+    on — and must land on the same cross-rank-identical params."""
     env = dict(os.environ)
     env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    if async_mode:
+        env["KF_CONFIG_ASYNC"] = async_mode
     r = subprocess.run(
         [
             sys.executable, "-m", "kungfu_tpu.runner.cli",

@@ -237,13 +237,13 @@ def test_wire_error_bound_chunked_and_fused(clusters, monkeypatch):
     chunk size) and bucket fusion through the 3-stage pipeline (tiny
     bucket cap), bf16 wire — error still within the k-independent
     2-step bound and peers bit-identical."""
-    from kungfu_tpu.collective import host_session as hs
+    from kungfu_tpu.collective import walks
 
     monkeypatch.setenv("KF_CONFIG_WIRE", "bf16")
     monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
     monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
     monkeypatch.setattr(HostSession, "GROUP_BUCKET_BYTES", 4096)
-    monkeypatch.setattr(hs, "CHUNK_BYTES", 256 << 10)  # forces k>1 chunks
+    monkeypatch.setattr(walks, "CHUNK_BYTES", 256 << 10)  # forces k>1 chunks
     np_ = 4
     cluster = clusters(np_)
     rng = np.random.default_rng(5)
