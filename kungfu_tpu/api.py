@@ -211,6 +211,77 @@ def _group_outs(xs, flats, outs):
     return [o.reshape(-1) for o in outs]
 
 
+def reduce_scatter(
+    x: np.ndarray, op: ReduceOp = ReduceOp.SUM, name: str = "user"
+) -> np.ndarray:
+    """First-class reduce-scatter (ISSUE 11): reduce `x` across the
+    cluster and return only this rank's owned 1/k shard — the RS half of
+    the segmented ring walk, (k-1)/k·N bytes per peer, f32-exact. The
+    shard layout is ``plan.topology.owned_segment_bounds`` (contiguous
+    ``even_partition`` segments of the FLATTENED array), identical on
+    every peer without negotiation; ranks beyond the element count get
+    an empty shard (the n<k edge the segmented walk already handles).
+    ``all_gather(reduce_scatter(x))`` == ``all_reduce_array(x)`` bit for
+    bit."""
+    flat = np.ascontiguousarray(x).reshape(-1)
+    out = np.empty_like(flat)
+    w = Workspace(send=flat, recv=out, op=op, name=f"kungfu::user::rs:{name}")
+    b, e = get_default_peer().current_session().reduce_scatter(w)
+    return out[b:e].copy()
+
+
+def all_gather(shard: np.ndarray, name: str = "user") -> np.ndarray:
+    """Standalone segment all-gather (ISSUE 11): every rank contributes
+    its owned shard (the ``reduce_scatter`` layout) and receives the
+    reassembled full array, identical on all peers. The shard must be
+    exactly this rank's ``owned_segment_bounds`` slice — a mismatched
+    size fails fast here, not as a wire-framing corruption. Rides the
+    wire codec like allreduce (bf16 on the wire for eligible f32
+    payloads, each segment quantized once by its owner; see
+    docs/collectives.md for the error model)."""
+    from kungfu_tpu.plan import topology as _topo
+
+    sess = get_default_peer().current_session()
+    flat = np.ascontiguousarray(shard).reshape(-1)
+    # one int64 lane agrees the total element count (shard sizes differ
+    # by one across ranks under even_partition, so it is not derivable
+    # locally); exact, never compressed
+    total = int(all_reduce_array(
+        np.array([flat.size], np.int64), ReduceOp.SUM, f"agsz:{name}"
+    )[0])
+    b, e = _topo.owned_segment_bounds(total, sess.size, sess.rank)
+    if flat.size != e - b:
+        raise ValueError(
+            f"all_gather shard has {flat.size} elements but rank "
+            f"{sess.rank} owns [{b}:{e}) of {total} — shards must follow "
+            "the reduce_scatter layout (owned_segment_bounds)"
+        )
+    full = np.empty(total, flat.dtype)
+    full[b:e] = flat
+    sess.all_gather_shards(full, f"kungfu::user::ag:{name}")
+    return full
+
+
+def sharded_update_session(
+    params, lr: float, momentum: float = 0.0, name: str = "zero",
+    restore_state: "Optional[bytes]" = None,
+):
+    """Build a :class:`~kungfu_tpu.collective.zero.ShardedUpdateSession`
+    — the ZeRO-1 sharded SGD update over the current session (ISSUE 11):
+    reduce-scatter gradients, update (and hold optimizer state for) only
+    this rank's 1/k shard, all-gather the updated weights (bf16 on the
+    wire when the codec wins). See the module docstring for the
+    synchronous and scheduler-overlapped driving patterns and the
+    resize/re-shard contract (`export_state`/`restore_state`)."""
+    from kungfu_tpu.collective.zero import ShardedSGD, ShardedUpdateSession
+
+    return ShardedUpdateSession(
+        params, ShardedSGD(lr, momentum=momentum), name=name,
+        session=get_default_peer().current_session(),
+        restore_state=restore_state,
+    )
+
+
 def broadcast_array(x: np.ndarray, root: int = 0, name: str = "user") -> np.ndarray:
     """Host-plane broadcast from `root` (arbitrary roots, parity: the
     reference's Broadcast op)."""

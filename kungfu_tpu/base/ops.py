@@ -64,6 +64,28 @@ def reduce_inplace(acc: np.ndarray, incoming: np.ndarray, op: ReduceOp) -> None:
     transform2(acc, acc, incoming, op)
 
 
+def _check_segment(buf: np.ndarray, begin: int, end: int,
+                   incoming: np.ndarray) -> None:
+    """Segment-bounds contract of the ring walks AND the sharded update
+    (ISSUE 11): [begin, end) must lie inside the buffer and `incoming`
+    must carry exactly end-begin elements. The native transform kernels
+    take raw pointers and do NOT shape-check, so a shard-layout drift
+    between sender and receiver (e.g. tensors that don't divide by k,
+    partitioned differently on each side) must fail HERE, loudly — not
+    corrupt adjacent segments silently. The layout itself is
+    single-sourced in plan.topology.owned_segment_bounds/even_partition."""
+    if not 0 <= begin <= end <= buf.size:
+        raise ValueError(
+            f"segment [{begin}:{end}) outside buffer of {buf.size} elements"
+        )
+    if incoming.size != end - begin:
+        raise ValueError(
+            f"segment payload mismatch: got {incoming.size} elements for "
+            f"segment [{begin}:{end}) of {end - begin} — sender and "
+            "receiver partitioned the payload differently"
+        )
+
+
 def reduce_segment(
     acc: np.ndarray, begin: int, end: int, incoming: np.ndarray, op: ReduceOp
 ) -> None:
@@ -73,6 +95,7 @@ def reduce_segment(
     is a zero-copy view into the full recv buffer, so per-step reduction
     touches only the 1/k segment on the wire — no staging copies, no
     full-payload passes."""
+    _check_segment(acc, begin, end, incoming)
     seg = acc[begin:end]
     transform2(seg, seg, incoming, op)
 
@@ -81,6 +104,7 @@ def copy_segment(
     dst: np.ndarray, begin: int, end: int, incoming: np.ndarray
 ) -> None:
     """dst[begin:end] = incoming (all-gather phase: overwrite, no reduce)."""
+    _check_segment(dst, begin, end, incoming)
     np.copyto(dst[begin:end], incoming)
 
 
@@ -158,6 +182,7 @@ def decode_accumulate(
     payload is read once; the fallback decodes into a temporary then
     reduces (two passes, still f32 accumulation)."""
     _check_wire(wire)
+    _check_segment(acc, begin, end, src)
     seg = acc[begin:end]
     native = _wire_native()
     if native is not None:

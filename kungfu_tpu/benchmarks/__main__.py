@@ -261,6 +261,172 @@ def bench_host_async_ab(model: str, iters: int, warmup: int = 4) -> None:
     )
 
 
+def bench_host_zero_ab(model: str, iters: int) -> None:
+    """Paired same-process ZeRO-1 A/B (ISSUE 11): the REPLICATED leg
+    runs the classic step — simulated backward, step-end group
+    allreduce, full-param SGD update with full-size momentum on every
+    peer — while the SHARDED leg submits each tensor to the sharded
+    update session as its compute finishes (reduce-scatter → 1/k shard
+    update → weight all-gather, all riding the async scheduler) and
+    defers the weight barrier to the TOP of the next step, so tail
+    all-gathers overlap the next step's simulated backward. Legs
+    interleave in alternating rounds within one process/session like
+    --wire-ab, so box drift cancels out of the ratio. Reports per-leg
+    RESULT throughput, the UPDATE line (full vs 1/k optimizer-update
+    seconds), the STATE line (full vs shard optimizer bytes), per-leg
+    WIRE lines (2·(k-1)/k·N allreduce vs (k-1)/k·N reduce-scatter +
+    (k-1)/k·N[/2] weight all-gather) and the scheduler OVERLAP line."""
+    from kungfu_tpu import api
+    from kungfu_tpu.collective.zero import ShardedSGD, ShardedUpdateSession
+    from kungfu_tpu.models.fake import fake_gradients
+    from kungfu_tpu.peer import get_default_peer
+    from kungfu_tpu.telemetry import metrics as tmetrics
+
+    lr, momentum = 0.1, 0.9
+    grads = fake_gradients(model)
+    params_r = fake_gradients(model, seed=1)
+    params_z = fake_gradients(model, seed=1)
+    outs = [np.empty_like(g) for g in grads]
+    scratch = [np.empty_like(g) for g in grads]
+    total_bytes = sum(g.nbytes for g in grads)
+    k = api.cluster_size()
+    sess = get_default_peer().current_session()
+    if not sess.async_enabled():
+        raise SystemExit(
+            "--zero A/B needs the scheduler: KF_CONFIG_ASYNC=on|auto must "
+            "reach every worker before the session comes up (the --zero "
+            "flag sets it process-wide; under kfrun use KF_BENCH_ZERO "
+            "with the bench agent)"
+        )
+    zs = ShardedUpdateSession(params_z, ShardedSGD(lr, momentum),
+                              name="zbench", session=sess)
+    repl_opt = ShardedSGD(lr, momentum)
+    repl_state = [repl_opt.init(g.size) for g in grads]
+    # replicated optimizer state = full-size momentum on every peer
+    # (the params themselves are its masters)
+    repl_state_bytes = sum(
+        a.nbytes for st in repl_state for a in st.values()
+    )
+    n = len(grads)
+    sched = sess.scheduler()
+    update_ctr = tmetrics.counter(
+        "kungfu_sharded_update_seconds_total",
+        "Seconds spent in the shard-local optimizer update "
+        "(the k-fold-reduced update FLOPs of ZeRO-1)",
+    )
+    repl_update_s = 0.0
+
+    def run_repl(tag: str) -> None:
+        nonlocal repl_update_s
+        _simulated_backprop(grads, scratch)
+        api.group_all_reduce_arrays(grads, name=tag, outs=outs)
+        t0 = time.perf_counter()
+        for i in range(n):
+            repl_opt.apply(params_r[i], outs[i], repl_state[i], 1.0 / k)
+        repl_update_s += time.perf_counter() - t0
+
+    def run_zero() -> None:
+        # the previous step's tail weight all-gathers land while THIS
+        # step's backward computes — wait only at the point the params
+        # would actually be consumed
+        zs.wait_params()
+        for i in reversed(range(n)):  # readiness order: last layer first
+            _simulated_backprop(grads[i:i + 1], scratch[i:i + 1])
+            zs.submit_grad(i, grads[i])
+        zs.flush()
+
+    api.run_barrier()
+    for i in range(2):
+        run_repl(f"wu:{i}")
+    run_zero()  # registration round + staging warmup
+    api.run_barrier()
+    legs: dict = {"replicated": [], "sharded": []}
+    wire: dict = {"replicated": {}, "sharded": {}}
+    rounds = 8
+    per = max(1, iters // 4)
+    stats0 = sched.stats()
+    repl_update_s = 0.0
+    update0 = update_ctr.value
+    repl_rounds = zero_rounds = 0
+    for rnd in range(rounds):
+        mode = "replicated" if rnd % 2 == 0 else "sharded"
+        samples = legs[mode]
+        before = _wire_samples()
+        for it in range(per):
+            t0 = time.perf_counter()
+            if mode == "replicated":
+                run_repl(f"ab:{rnd}:{it}")
+                repl_rounds += 1
+            else:
+                run_zero()
+                zero_rounds += 1
+            samples.append(
+                total_bytes / (time.perf_counter() - t0) / (1 << 30)
+            )
+        if mode == "sharded":
+            zs.wait_params()  # attribute the tail to the leg it belongs to
+        after = _wire_samples()
+        for labels, v in after.items():
+            d = v - before.get(labels, 0.0)
+            if d > 0:
+                wire[mode][labels] = wire[mode].get(labels, 0.0) + d
+        api.run_barrier()
+    stats1 = sched.stats()
+    zero_update_s = update_ctr.value - update0
+    if api.current_rank() != 0:
+        return
+    meds = {m: float(np.median(s)) for m, s in legs.items()}
+    for m, s in legs.items():
+        log.echo(
+            f"RESULT: {float(np.mean(s)):.3f} "
+            f"+-{float(1.96 * np.std(s)):.3f} (GiB/s) "
+            f"median {meds[m]:.3f} [HOST-AB zero={m}, "
+            f"x{k} workers, {model}, {len(s)} interleaved samples]"
+        )
+    log.echo(
+        f"RESULT: sharded / replicated median speedup: "
+        f"{meds['sharded'] / meds['replicated']:.2f}x [interleaved "
+        f"paired, {model}, simulated backprop]"
+    )
+    ru = repl_update_s / max(1, repl_rounds) * 1e3
+    zu = zero_update_s / max(1, zero_rounds) * 1e3
+    log.echo(
+        f"UPDATE {model}: replicated {ru:.1f} ms/step vs sharded "
+        f"{zu:.1f} ms/step ({ru / zu if zu > 0 else float('inf'):.1f}x "
+        f"less update compute at k={k})"
+    )
+    mom_bytes = sum(
+        a.nbytes for b in zs._buckets for a in b.state.values()
+    )
+    master_bytes = sum(b.master.nbytes for b in zs._buckets)
+    log.echo(
+        f"STATE {model}: replicated {repl_state_bytes / (1 << 20):.1f} MiB "
+        f"momentum vs sharded {zs.state_bytes() / (1 << 20):.1f} MiB "
+        f"(momentum {mom_bytes / (1 << 20):.1f} — {repl_state_bytes / max(1, mom_bytes):.1f}x "
+        f"less — + f32 shard masters {master_bytes / (1 << 20):.1f}); "
+        f"total {repl_state_bytes / max(1, zs.state_bytes()):.1f}x less per peer"
+    )
+    for mode in ("replicated", "sharded"):
+        per_leg = max(1, per * rounds // 2)
+        for labels, d in sorted(wire[mode].items()):
+            per_iter = d / per_leg
+            log.echo(
+                f"WIRE zero={mode} {labels}: {per_iter / (1 << 20):.1f} "
+                f"MiB/iter ({per_iter / total_bytes:.2f}x payload)"
+            )
+    a_rounds = max(1, stats1["rounds"] - stats0["rounds"])
+    flush_wait = (stats1["flush_wait_s"] - stats0["flush_wait_s"]) / a_rounds
+    busy = (stats1["busy_s"] - stats0["busy_s"]) / a_rounds
+    overlap = (stats1["overlap_s"] - stats0["overlap_s"]) / a_rounds
+    frac = overlap / busy if busy > 0 else 0.0
+    log.echo(
+        f"OVERLAP {model}: flush-wait {flush_wait * 1e3:.1f} ms vs engine "
+        f"{busy * 1e3:.1f} ms per step — {frac:.0%} of engine time "
+        f"(reduce-scatter + update + weight all-gather) overlapped with "
+        f"caller compute"
+    )
+
+
 def bench_host(model: str, iters: int, warmup: int = 4) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
@@ -455,6 +621,16 @@ def main() -> None:
         "medians and the drift-free speedup ratio",
     )
     p.add_argument(
+        "--zero", action="store_true", dest="zero_ab",
+        help="HOST only: paired same-process ZeRO-1 A/B — alternate the "
+        "replicated step (group allreduce + full-param SGD, full-size "
+        "momentum) with the sharded update (reduce-scatter → 1/k shard "
+        "update → weight all-gather through the async scheduler; sets "
+        "KF_CONFIG_ASYNC=on and KF_CONFIG_ZERO=on before the session "
+        "comes up), report per-leg medians, UPDATE/STATE/WIRE lines and "
+        "the OVERLAP line",
+    )
+    p.add_argument(
         "--async", action="store_true", dest="async_ab",
         help="HOST only: paired same-process async-scheduler A/B — "
         "alternate the serial step loop (compute all, then one step-end "
@@ -466,12 +642,14 @@ def main() -> None:
     args = p.parse_args()
     if args.method != "HOST" and (
         args.algo or args.wire or args.wire_ab or args.async_ab
+        or args.zero_ab
     ):
         # the default method is XLA: silently measuring the wrong plane
         # is worse than an error
-        p.error("--algo/--wire/--wire-ab/--async only apply to --method HOST")
-    if args.wire_ab and args.async_ab:
-        p.error("--wire-ab and --async are separate A/Bs — pick one")
+        p.error("--algo/--wire/--wire-ab/--async/--zero only apply to "
+                "--method HOST")
+    if sum(1 for f in (args.wire_ab, args.async_ab, args.zero_ab) if f) > 1:
+        p.error("--wire-ab/--async/--zero are separate A/Bs — pick one")
     if args.method == "HOST":
         import os
 
@@ -481,6 +659,9 @@ def main() -> None:
             os.environ["KF_CONFIG_WIRE"] = args.wire
         if args.async_ab:
             os.environ["KF_CONFIG_ASYNC"] = "on"
+        if args.zero_ab:
+            os.environ["KF_CONFIG_ASYNC"] = "on"
+            os.environ["KF_CONFIG_ZERO"] = "on"
         # wire-byte accounting rides the metrics gate; the bench wants it
         # on regardless so the A/B always reports bytes per peer
         from kungfu_tpu.telemetry import config as tconfig
@@ -496,6 +677,8 @@ def main() -> None:
         bench_host_wire_ab(args.model, args.iters)
     elif args.async_ab:
         bench_host_async_ab(args.model, args.iters)
+    elif args.zero_ab:
+        bench_host_zero_ab(args.model, args.iters)
     else:
         bench_host(args.model, args.iters)
 

@@ -159,6 +159,13 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         # scheduler itself is created lazily on first use (most sessions
         # — control planes, tests — never submit asynchronously)
         self.async_mode = knobs.get("KF_CONFIG_ASYNC")
+        # ZeRO-1 sharded-update knob (ISSUE 11): resolved once per epoch
+        # like the strategy/wire/async modes; consulted by the frontends
+        # (ShardedUpdateSession, torch ZeroSGDOptimizer, api helpers) to
+        # pick sharded vs replicated updates. Cluster-agreed — it decides
+        # the step's whole rendezvous dataflow (zrs/zag names vs fused
+        # allreduce names), so it rides the knob consensus.
+        self.zero_mode = knobs.get("KF_CONFIG_ZERO")
         self._scheduler: Optional["CollectiveScheduler"] = None
         self._scheduler_lock = threading.Lock()
         self._epoch_closed = False
@@ -262,6 +269,18 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             return self.size >= 2
         return False
 
+    def zero_enabled(self) -> bool:
+        """Whether this epoch runs the ZeRO-1 sharded weight update
+        (ISSUE 11). `auto` resolves to on for multi-peer sessions (a
+        cluster of one has nothing to shard). Cluster-agreed — the mode
+        decides the step's rendezvous dataflow, so it rides the knob
+        consensus like KF_CONFIG_ASYNC."""
+        if self.zero_mode == "on":
+            return True
+        if self.zero_mode == "auto":
+            return self.size >= 2
+        return False
+
     def scheduler(self) -> "CollectiveScheduler":
         """The session's async collective scheduler, created on first
         use. Lives exactly as long as the session epoch: Peer._update_to
@@ -310,6 +329,50 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         with self._collected("all_reduce", w.recv.nbytes):
             with stall_detect(f"all_reduce({w.name})"):
                 self._allreduce_ws(w)
+
+    def reduce_scatter(
+        self, w: Workspace, cancel: Optional[threading.Event] = None
+    ) -> Tuple[int, int]:
+        """First-class reduce-scatter half of the segmented ring walk
+        (ISSUE 11): after it, ``w.recv`` holds the fully reduced OWNED
+        segment — whose (begin, end) element bounds are returned — and
+        partially reduced garbage elsewhere. The layout is
+        ``topo.owned_segment_bounds(size, k, rank)``: contiguous
+        ``even_partition`` segments, identical on every peer without
+        negotiation. Always raw f32-exact ((k-1)/k·N bytes per peer);
+        k == 1 (and empty payloads) degrade to ``forward()`` with the
+        whole array owned. Runs the ring regardless of payload size —
+        an explicit RS is a deliberate choice, not a heuristic."""
+        with self._collected("reduce_scatter", w.recv.nbytes):
+            with stall_detect(f"reduce_scatter({w.name})"):
+                self._run_segmented(w, cancel=cancel, phase="rs")
+        return topo.owned_segment_bounds(w.recv.size, self.size, self.rank)
+
+    def all_gather_shards(
+        self,
+        full: np.ndarray,
+        name: str,
+        cancel: Optional[threading.Event] = None,
+        allow_wire: bool = True,
+    ) -> None:
+        """Standalone segment all-gather (ISSUE 11): the caller placed
+        this rank's shard into ``full``'s owned segment
+        (``topo.owned_segment_bounds``); the walk relays every segment
+        around the ring until ``full`` is complete and identical on all
+        peers. The inverse of :meth:`reduce_scatter` — rs + this ==
+        all_reduce, bit for bit.
+
+        With the wire codec active (and ``allow_wire``) eligible f32
+        payloads cross the transport in the wire dtype — (k-1)/k·N/2
+        bytes per peer — with each segment quantized exactly once by its
+        owner and decoded once per peer at walk end, so every peer
+        (owner included) lands on bit-identical values; see
+        docs/collectives.md for the error model."""
+        ws = Workspace(send=full, recv=full, op=ReduceOp.SUM, name=name)
+        wire = self._wire_codec_for(ws) if allow_wire else None
+        with self._collected("all_gather", full.nbytes):
+            with stall_detect(f"all_gather({name})"):
+                self._run_segmented(ws, cancel=cancel, wire=wire, phase="ag")
 
     def monitored_all_reduce(self, w: Workspace) -> None:
         """AllReduce + throughput accounting for the ACTIVE strategy
@@ -575,6 +638,7 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             ("KF_CONFIG_WIRE", self.wire_mode),
             ("KF_CONFIG_WIRE_MIN_BYTES", str(self.WIRE_MIN_BYTES)),
             ("KF_CONFIG_ASYNC", self.async_mode),
+            ("KF_CONFIG_ZERO", self.zero_mode),
         ]
 
     def _fixed_allreduce(self, w: Workspace) -> None:

@@ -47,10 +47,28 @@ thread and re-read the active (strategy, wire) candidate per workspace,
 and every vote runs at a step boundary (after `flush()`), when no
 bucket is in flight.
 
+**Sharded (ZeRO-1) units** (ISSUE 11). A submission carrying a
+``handler`` (a :class:`~kungfu_tpu.collective.zero.ShardedUpdateSession`)
+registers as a *sharded* tensor: its buckets run
+reduce-scatter → shard-optimizer-update → weight-all-gather → scatter
+instead of allreduce → unpack, driven across a 4-stage pipeline
+(launcher packs, walker reduce-scatters and updates, a dedicated
+gatherer walks the weight all-gather, the unpacker scatters weights).
+Completion splits in two: ``flush()`` returns once every sharded
+bucket's SHARD has updated (gradients consumed — the step barrier),
+while weight all-gathers keep walking and overlap the caller's
+next-step compute; :meth:`~CollectiveScheduler.wait_gather` is the
+barrier for those (call it before the next forward consumes the
+params). The submission kind is part of the registered identity and the
+registration consensus, and sharded walk names carry their own
+round-stamped wire names (``:zrs:r{n}`` / ``:zag:r{n}``), so sharded
+and allreduce traffic of adjacent rounds can never collide.
+
 Telemetry: `kungfu_scheduler_queued_buckets` /
 `kungfu_scheduler_overlap_seconds_total` /
 `kungfu_scheduler_flush_wait_seconds` plus `sched.pack` / `sched.walk`
-/ `sched.unpack` / `sched.flush` spans (docs/telemetry.md).
+/ `sched.gather` / `sched.unpack` / `sched.flush` spans
+(docs/telemetry.md).
 """
 
 from __future__ import annotations
@@ -72,16 +90,20 @@ from kungfu_tpu.utils.stall import stall_detect
 # kfcheck KF303: every thread this module starts must be declared here
 # (the abort-protocol joinable set) — close() joins exactly these, so a
 # future stage cannot silently outlive a session epoch.
-_KF_JOINABLE_THREADS = ("kf-sched-launch", "kf-sched-walk", "kf-sched-unpack")
+_KF_JOINABLE_THREADS = (
+    "kf-sched-launch", "kf-sched-walk", "kf-sched-gather", "kf-sched-unpack",
+)
 
 # registered-tensor identity: rendezvous-relevant properties only (the
 # consensus digest is built from these, so any cross-peer divergence in
-# name, length, dtype or op is caught at registration)
-_Key = Tuple[str, int, str, int]
+# name, length, dtype, op or submission KIND — "ar" allreduce vs "zero"
+# sharded-update, which walk entirely different dataflows — is caught
+# at registration)
+_Key = Tuple[str, int, str, int, str]
 
 
-def _key_of(w: Workspace) -> _Key:
-    return (w.name, int(w.send.size), w.send.dtype.str, int(w.op))
+def _key_of(w: Workspace, kind: str = "ar") -> _Key:
+    return (w.name, int(w.send.size), w.send.dtype.str, int(w.op), kind)
 
 
 class SchedulerClosed(RuntimeError):
@@ -90,17 +112,22 @@ class SchedulerClosed(RuntimeError):
 
 
 class _Unit:
-    """One launch unit of the negotiated plan: a fused bucket (>= the
-    fusion threshold, same dtype/op, <= the bucket byte cap) or a single
-    workspace. Derived purely from the registered order and the
-    cluster-agreed knobs, so every peer computes the identical plan."""
+    """One launch unit of the negotiated plan: a fused allreduce bucket
+    (>= the fusion threshold, same dtype/op, <= the bucket byte cap), a
+    single workspace, or a sharded-update (ZeRO-1) bucket whose layout
+    the registered handler owns. Derived purely from the registered
+    order, the cluster-agreed knobs and the handler's deterministic
+    bucket layout, so every peer computes the identical plan."""
 
-    __slots__ = ("index", "keys", "fused")
+    __slots__ = ("index", "keys", "fused", "kind", "zindex")
 
-    def __init__(self, index: int, keys: List[_Key], fused: bool):
+    def __init__(self, index: int, keys: List[_Key], fused: bool,
+                 kind: str = "ar", zindex: int = -1):
         self.index = index
         self.keys = keys
         self.fused = fused
+        self.kind = kind  # "ar" | "zero"
+        self.zindex = zindex  # handler bucket index for zero units
 
 
 class CollectiveScheduler:
@@ -118,22 +145,34 @@ class CollectiveScheduler:
         self._registry: Optional[List[_Key]] = None
         self._known: set = set()
         self._plan: List[_Unit] = []
-        self._first_round: List[Tuple[int, int, Workspace]] = []  # (prio, seq, w)
+        # (prio, seq, workspace, kind) of pre-registration submissions
+        self._first_round: List[Tuple[int, int, Workspace, str]] = []
+        # the sharded-update handler (ZeRO-1): one per scheduler epoch,
+        # bound by the first submit that carries it; owns the sharded
+        # buckets' layout, buffers and optimizer state
+        self._handler = None
         # per-round state (all under _cond)
         self._round = 0
         self._pending: Dict[_Key, Workspace] = {}
         self._submitted: set = set()
         self._next_unit = 0
-        self._completed = 0
-        self._busy_s = 0.0  # pack+walk+unpack seconds this round
+        # flush barrier: units whose GRADIENT work finished this round —
+        # allreduce units at unpack, sharded units once their shard
+        # updated (their weight all-gather keeps walking past flush)
+        self._grad_done = 0
+        # sharded units whose weight all-gather + scatter has not landed
+        # yet (spans round boundaries; wait_gather's barrier)
+        self._gather_outstanding = 0
+        self._busy_s = 0.0  # pack+walk+gather+unpack seconds this round
         self._queued = 0  # units packed but not yet unpacked (gauge)
         # lifetime stats (for the bench OVERLAP report)
         self._stat = {
-            "rounds": 0, "units": 0, "buckets": 0,
+            "rounds": 0, "units": 0, "buckets": 0, "zero_units": 0,
             "flush_wait_s": 0.0, "busy_s": 0.0, "overlap_s": 0.0,
         }
         self._threads: List[threading.Thread] = []
         self._walkq = HandoffQueue(maxsize=self.queue_depth, abort=self._abort)
+        self._gatherq = HandoffQueue(maxsize=1, abort=self._abort)
         self._unpackq = HandoffQueue(maxsize=1, abort=self._abort)
         if tconfig.metrics_enabled():
             self._queued_gauge = tmetrics.gauge(
@@ -159,7 +198,8 @@ class CollectiveScheduler:
     # public API
     # ------------------------------------------------------------------
 
-    def submit(self, w: Workspace, priority: Optional[int] = None) -> None:
+    def submit(self, w: Workspace, priority: Optional[int] = None,
+               handler=None) -> None:
         """Hand one tensor's workspace to the scheduler as it becomes
         ready. Thread-safe; returns immediately (the walk happens on the
         scheduler threads). `w.recv` must stay valid until the round's
@@ -170,23 +210,42 @@ class CollectiveScheduler:
         `priority` shapes the negotiated launch order during the FIRST
         round only (lower launches earlier, default = arrival order);
         after registration the cluster-wide registered order governs and
-        the argument is ignored."""
+        the argument is ignored.
+
+        `handler` (a ShardedUpdateSession) marks this tensor as a
+        sharded-update (ZeRO-1) gradient: its bucket runs
+        reduce-scatter → shard update → weight all-gather instead of an
+        allreduce, and `w.recv` is NOT written (the deliverable is the
+        updated params, scattered by the handler). The kind is part of
+        the registered identity — pass the handler on EVERY submit of a
+        sharded tensor."""
         if w.is_empty:
             return
-        key = _key_of(w)
+        kind = "ar" if handler is None else "zero"
+        key = _key_of(w, kind)
         with self._cond:
             self._raise_if_dead_locked()
+            if handler is not None:
+                if self._handler is None:
+                    self._handler = handler
+                elif self._handler is not handler:
+                    raise ValueError(
+                        "a scheduler epoch supports ONE sharded-update "
+                        "handler — rebuild the ShardedUpdateSession "
+                        "instead of mixing two"
+                    )
             if self._registry is None:
                 seq = len(self._first_round)
                 prio = seq if priority is None else int(priority)
-                self._first_round.append((prio, seq, w))
+                self._first_round.append((prio, seq, w, kind))
                 return
             if key not in self._known:
                 raise ValueError(
                     f"submit of unregistered tensor {key[0]!r} "
-                    f"(size={key[1]}, dtype={key[2]}, op={key[3]}) — the "
-                    "registered set is negotiated at the first flush and "
-                    "fixed for the session epoch; resize to change it"
+                    f"(size={key[1]}, dtype={key[2]}, op={key[3]}, "
+                    f"kind={key[4]}) — the registered set is negotiated "
+                    "at the first flush and fixed for the session epoch; "
+                    "resize to change it"
                 )
             if key in self._submitted:
                 raise ValueError(
@@ -206,6 +265,10 @@ class CollectiveScheduler:
         t0 = time.perf_counter()
         with trace.span("sched.flush"), stall_detect("scheduler.flush"):
             with self._cond:
+                # a dead handle reports its real state even on would-be
+                # no-op flushes: a cleanly-flushed round followed by a
+                # resize must surface SchedulerClosed, not silence
+                self._raise_if_dead_locked()
                 if self._registry is None and not self._first_round:
                     # nothing was ever submitted: a defensive flush must
                     # NOT register an empty set (that would freeze the
@@ -244,24 +307,26 @@ class CollectiveScheduler:
                             "collective scheduler closed (session epoch "
                             "ended) during flush"
                         )
-                    if self._completed >= len(self._plan):
+                    if self._grad_done >= len(self._plan):
                         break
                     if time.monotonic() >= deadline:
                         self._abort.set()
                         raise TimeoutError(
                             f"scheduler flush timed out: "
-                            f"{self._completed}/{len(self._plan)} units "
+                            f"{self._grad_done}/{len(self._plan)} units "
                             f"done in round {self._round}"
                         )
                     self._cond.wait(0.2)
-                # advance the round
+                # advance the round (sharded units' weight all-gathers
+                # may still be walking — wait_gather is their barrier;
+                # round-stamped wire names keep them collision-free)
                 wait = time.perf_counter() - t0
                 busy = self._busy_s
                 self._round += 1
                 self._pending.clear()
                 self._submitted.clear()
                 self._next_unit = 0
-                self._completed = 0
+                self._grad_done = 0
                 self._busy_s = 0.0
                 self._stat["rounds"] += 1
                 self._stat["flush_wait_s"] += wait
@@ -292,6 +357,40 @@ class CollectiveScheduler:
                 if self._round > round_index:
                     return
         self.flush(timeout=timeout)
+
+    def wait_gather(self, timeout: Optional[float] = None) -> None:
+        """Barrier for the sharded units' weight all-gathers (ISSUE 11):
+        block until every in-flight gather has walked and its weights
+        have been scattered back. ``flush()`` deliberately does NOT wait
+        for these — they overlap the caller's next-step compute the way
+        gradient buckets overlap backward — so call this before the
+        next forward consumes the params. No-op when nothing sharded is
+        in flight; re-raises the scheduler's real error like flush."""
+        if timeout is None:
+            timeout = self.sess.timeout * max(1, len(self._plan))
+        deadline = time.monotonic() + timeout
+        with trace.span("sched.wait_gather"), stall_detect("scheduler.wait_gather"):
+            with self._cond:
+                while True:
+                    if self._errors:
+                        raise self._errors[0]
+                    if self._gather_outstanding == 0:
+                        return
+                    if self._closed:
+                        raise SchedulerClosed(
+                            "collective scheduler closed (session epoch "
+                            "ended) with weight all-gathers in flight — "
+                            "the resize drained or cancelled them; "
+                            "restore params via the elastic state sync"
+                        )
+                    if time.monotonic() >= deadline:
+                        self._abort.set()
+                        raise TimeoutError(
+                            f"wait_gather timed out with "
+                            f"{self._gather_outstanding} weight "
+                            "all-gathers in flight"
+                        )
+                    self._cond.wait(0.2)
 
     def stats(self) -> dict:
         """Lifetime scheduler stats (bench OVERLAP report): rounds,
@@ -346,7 +445,7 @@ class CollectiveScheduler:
                 return
             snapshot = list(self._first_round)
             entries = sorted(snapshot, key=lambda e: (e[0], e[1]))
-            registry = [_key_of(w) for _, _, w in entries]
+            registry = [_key_of(w, kd) for _, _, w, kd in entries]
             if len(set(registry)) != len(registry):
                 dupes = sorted(
                     {k[0] for k in registry if registry.count(k) > 1}
@@ -355,11 +454,17 @@ class CollectiveScheduler:
                     f"duplicate tensors in first round: {dupes} — "
                     "registered names must be unique"
                 )
+            if any(k[4] == "zero" for k in registry) and self._handler is None:
+                raise ValueError(
+                    "sharded tensors registered without a sharded-update "
+                    "handler — submit them through "
+                    "ShardedUpdateSession.submit_grad"
+                )
         # consensus OUTSIDE the lock: this runs real collectives on the
         # knob-independent star walk (check_knob_consensus machinery) —
         # the walk must not serialize behind the scheduler's own lock
         digest = ";".join(
-            f"{n}:{s}:{d}:{o}" for n, s, d, o in registry
+            f"{n}:{s}:{d}:{o}:{kd}" for n, s, d, o, kd in registry
         ).encode()
         if not self.sess._bytes_agree(
             digest, ":sched:registry", self.sess._fixed_allreduce
@@ -383,11 +488,11 @@ class CollectiveScheduler:
             # tensor would leave stale recv data behind a clean flush.
             pending: Dict[_Key, Workspace] = {}
             submitted: set = set()
-            for _, _, w in snapshot:
-                pending[_key_of(w)] = w
-                submitted.add(_key_of(w))
-            for _, _, w in self._first_round[len(snapshot):]:
-                key = _key_of(w)
+            for _, _, w, kd in snapshot:
+                pending[_key_of(w, kd)] = w
+                submitted.add(_key_of(w, kd))
+            for _, _, w, kd in self._first_round[len(snapshot):]:
+                key = _key_of(w, kd)
                 if key not in known:
                     raise ValueError(
                         f"tensor {key[0]!r} submitted during the "
@@ -416,12 +521,23 @@ class CollectiveScheduler:
         indices: same-(dtype, op) runs of >= FUSE_MIN_TENSORS fuse into
         <= GROUP_BUCKET_BYTES buckets (pipeline._make_buckets' greedy
         order-preserving packing); smaller groups launch as singles.
-        Pure function of (registry, cluster-agreed knobs) — every peer
-        derives the identical plan from the consensus-checked registry."""
+        Sharded ("zero") tensors instead map onto the handler's OWN
+        deterministic bucket layout — the handler holds their persistent
+        buffers and shard state, so its layout is authoritative and is
+        validated against the registered set. Pure function of
+        (registry, cluster-agreed knobs, handler layout) — every peer
+        derives the identical plan from the consensus-checked registry.
+        Units launch ordered by their first member's registered index
+        (deterministic, and readiness-shaped: early-registered =
+        early-ready gradients launch first)."""
         sess = self.sess
         groups: Dict[Tuple[str, int], List[_Key]] = {}
+        zero_keys: List[_Key] = []
         for key in registry:
-            groups.setdefault((key[2], key[3]), []).append(key)
+            if key[4] == "zero":
+                zero_keys.append(key)
+            else:
+                groups.setdefault((key[2], key[3]), []).append(key)
         units: List[_Unit] = []
         singles: List[_Key] = []
         for members in groups.values():
@@ -444,6 +560,16 @@ class CollectiveScheduler:
                 units.append(_Unit(len(units), cur, fused=True))
         for key in singles:
             units.append(_Unit(len(units), [key], fused=False))
+        if zero_keys:
+            for zi, keys in enumerate(self._handler.plan_units(zero_keys)):
+                units.append(
+                    _Unit(len(units), list(keys), fused=False,
+                          kind="zero", zindex=zi)
+                )
+        pos = {k: i for i, k in enumerate(registry)}
+        units.sort(key=lambda u: pos[u.keys[0]])
+        for i, u in enumerate(units):
+            u.index = i
         return units
 
     # ------------------------------------------------------------------
@@ -453,6 +579,7 @@ class CollectiveScheduler:
     def _start_threads_locked(self) -> None:
         self._spawn_registered("kf-sched-launch", self._launch_loop)
         self._spawn_registered("kf-sched-walk", self._walk_loop)
+        self._spawn_registered("kf-sched-gather", self._gather_loop)
         self._spawn_registered("kf-sched-unpack", self._unpack_loop)
 
     def _spawn_registered(self, name: str, target) -> None:
@@ -508,7 +635,13 @@ class CollectiveScheduler:
                     return
                 unit, members, rnd = claimed
                 t0 = time.perf_counter()
-                if unit.fused:
+                if unit.kind == "zero":
+                    with trace.span("sched.pack", unit=unit.index):
+                        # the handler packs into its persistent bucket
+                        # staging and stamps its own round-qualified
+                        # wire names (:zrs:/:zag:)
+                        item = self._handler.pack(unit.zindex, members, rnd)
+                elif unit.fused:
                     with trace.span("sched.pack", unit=unit.index):
                         # round-stamped fused name: back-to-back rounds
                         # must not collide on the wire (a fast peer's
@@ -544,6 +677,23 @@ class CollectiveScheduler:
                     continue  # drain to the sentinel
                 unit, item = got
                 t0 = time.perf_counter()
+                if unit.kind == "zero":
+                    with trace.span("sched.walk", unit=unit.index):
+                        item = self._handler.reduce_and_update(
+                            item, cancel=self._abort
+                        )
+                    self._add_busy(time.perf_counter() - t0)
+                    # the shard is updated: gradients are consumed, so
+                    # this unit passes the flush barrier NOW — its
+                    # weight all-gather continues downstream and
+                    # overlaps the caller's next-step compute
+                    with self._cond:
+                        self._grad_done += 1
+                        self._gather_outstanding += 1
+                        self._cond.notify_all()
+                    if not self._gatherq.put((unit, item)):
+                        return
+                    continue
                 with trace.span("sched.walk", unit=unit.index):
                     if unit.fused:
                         deferred = self.sess._allreduce_ws(
@@ -553,7 +703,31 @@ class CollectiveScheduler:
                         self.sess._allreduce_ws(item[0], cancel=self._abort)
                         deferred = None
                 self._add_busy(time.perf_counter() - t0)
-                if not self._unpackq.put((unit, item + (deferred,))):
+                if not self._gatherq.put((unit, item + (deferred,))):
+                    return
+        except BaseException as e:  # noqa: BLE001 - channeled to flush()
+            self._record_error(e)
+        finally:
+            self._gatherq.put(None)
+
+    def _gather_loop(self) -> None:
+        """Weight all-gather stage (sharded units only; allreduce units
+        pass straight through so the launch→walk→gather→unpack chain
+        stays linear and sentinel propagation stays single-producer)."""
+        try:
+            while True:
+                got = self._gatherq.get()
+                if got is None:
+                    return
+                if self._abort.is_set():
+                    continue  # drain to the sentinel
+                unit, item = got
+                if unit.kind == "zero":
+                    t0 = time.perf_counter()
+                    with trace.span("sched.gather", unit=unit.index):
+                        item = self._handler.gather(item, cancel=self._abort)
+                    self._add_busy(time.perf_counter() - t0)
+                if not self._unpackq.put((unit, item)):
                     return
         except BaseException as e:  # noqa: BLE001 - channeled to flush()
             self._record_error(e)
@@ -570,6 +744,16 @@ class CollectiveScheduler:
                     continue  # aborted: must not touch caller buffers
                 unit, item = got
                 t0 = time.perf_counter()
+                if unit.kind == "zero":
+                    with trace.span("sched.unpack", unit=unit.index):
+                        self._handler.scatter(item)
+                    self._add_busy(time.perf_counter() - t0, queued=-1)
+                    with self._cond:
+                        self._gather_outstanding -= 1
+                        self._stat["units"] += 1
+                        self._stat["zero_units"] += 1
+                        self._cond.notify_all()
+                    continue
                 if unit.fused:
                     with trace.span("sched.unpack", unit=unit.index):
                         self.sess._unpack_bucket(item)
@@ -582,7 +766,7 @@ class CollectiveScheduler:
                         deferred.close()
                 self._add_busy(time.perf_counter() - t0, queued=-1)
                 with self._cond:
-                    self._completed += 1
+                    self._grad_done += 1
                     self._stat["units"] += 1
                     if unit.fused:
                         self._stat["buckets"] += 1

@@ -234,6 +234,7 @@ class WalkEngine:
         cancel: Optional[threading.Event] = None,
         wire: Optional[DType] = None,
         defer_decode: bool = False,
+        phase: str = "all",
     ) -> Optional[DeferredDecode]:
         """Bandwidth-optimal segmented walk: a (k-1)-step reduce-scatter
         over contiguous segments followed by a (k-1)-step all-gather
@@ -269,7 +270,27 @@ class WalkEngine:
         `ranks` restricts the ring to a subset (hierarchical cross-host
         mode); non-members just forward send into recv. With
         `defer_decode` (compressed walks only) the walk-end decode is
-        skipped and the wire buffer returned — see DeferredDecode."""
+        skipped and the wire buffer returned — see DeferredDecode.
+
+        `phase` selects which half of the walk runs (ISSUE 11):
+
+        * ``"all"`` — the full allreduce (default, behavior unchanged);
+        * ``"rs"``  — stop after the reduce-scatter: ``w.recv`` holds the
+          fully reduced OWNED segment (``topo.owned_segment_bounds``) and
+          partial garbage elsewhere. Always raw — the reduce leg of the
+          sharded update keeps f32 exactness (the codec's win goes to the
+          weight all-gather), so ``wire`` is ignored;
+        * ``"ag"``  — the standalone all-gather: the caller already
+          placed this rank's segment into ``w.recv`` (use an INPLACE
+          workspace; ``forward()`` degenerates to a no-op) and the walk
+          relays every segment around the ring, wire-encoded when `wire`
+          is set (each segment quantized once by its owner, decoded once
+          per peer at walk end — every peer, owner included, lands on
+          bit-identical values)."""
+        if phase not in ("all", "rs", "ag"):
+            raise ValueError(f"unknown segmented phase: {phase!r}")
+        if phase == "rs":
+            wire = None  # the reduce leg stays exact f32 (see docstring)
         if w.is_empty:
             w.forward()
             return None
@@ -524,8 +545,23 @@ class WalkEngine:
                 sp.args["send_us"] = round((prof.send - s0) * 1e6)
 
         _t0 = time.perf_counter()
-        for s, (snd, rcv) in enumerate(sched.rs_steps):
-            timed_step("host.rs.step", "rs", s, snd, rcv)
+        if phase != "ag":
+            for s, (snd, rcv) in enumerate(sched.rs_steps):
+                timed_step("host.rs.step", "rs", s, snd, rcv)
+        if phase == "rs":
+            self._count_wire(
+                wire_bytes, Strategy.RING_SEGMENTED.name, "off", raw_bytes
+            )
+            wall = time.perf_counter() - _t0
+            trace.record(f"host.rs[{w.recv.nbytes >> 20}MiB]", wall)
+            # half walks move (k-1)/k·N = the optimal 2(k-1)/k volume of
+            # HALF the payload: score against the halved payload so the
+            # profiler's efficiency ratio stays meaningful
+            self._record_walk(
+                Strategy.RING_SEGMENTED.name, k, w.recv.nbytes // 2, wall,
+                prof, dsts=[send_peer],
+            )
+            return None
         if wire is not None:
             # seed the all-gather: quantize the owned (fully reduced)
             # segment ONCE; every peer — self included — will decode
@@ -549,10 +585,12 @@ class WalkEngine:
         wall = time.perf_counter() - _t0
         trace.record(f"host.segmented[{w.recv.nbytes >> 20}MiB]", wall)
         # the ring's only outgoing edge is the successor: score this walk
-        # against that link's measured bandwidth
+        # against that link's measured bandwidth (half walks against the
+        # correspondingly halved payload, see the rs return above)
         self._record_walk(
-            Strategy.RING_SEGMENTED.name, k, w.recv.nbytes, wall, prof,
-            dsts=[send_peer],
+            Strategy.RING_SEGMENTED.name, k,
+            w.recv.nbytes if phase == "all" else w.recv.nbytes // 2,
+            wall, prof, dsts=[send_peer],
         )
         return deferred
 

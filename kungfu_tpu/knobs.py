@@ -333,6 +333,18 @@ _knob("KF_CONFIG_ASYNC", "",
       "Cluster-agreed: the mode decides the fused rendezvous names, so "
       "it is checked by `check_knob_consensus` at every session epoch.",
       section=_SEC_ENGINE, kind="choice", strict=True, default_doc="off")
+_knob("KF_CONFIG_ZERO", "",
+      _choice("KF_CONFIG_ZERO", ("off", "on", "auto"), empty_as="off"),
+      "ZeRO-1 sharded weight update: gradients are reduce-scattered, "
+      "each peer runs the optimizer on (and holds state for) only its "
+      "1/k shard, and an all-gather of updated weights (bf16 on the "
+      "wire when `KF_CONFIG_WIRE` is active) broadcasts the result. "
+      "`on` shards on every multi-peer session, `auto` resolves to on "
+      "when the session has ≥2 peers, `off` keeps the replicated "
+      "update. Cluster-agreed: the mode decides the whole step's "
+      "rendezvous dataflow, so it is checked by `check_knob_consensus` "
+      "at every session epoch.",
+      section=_SEC_ENGINE, kind="choice", strict=True, default_doc="off")
 _knob("KF_CONFIG_ASYNC_QUEUE", "2", _int,
       "Async scheduler launch-queue depth: how many packed buckets may "
       "sit between the pack and walk stages (bounds live pooled staging "

@@ -2,10 +2,12 @@ from kungfu_tpu.optimizers.core import (
     adaptive_sgd,
     synchronous_averaging,
     synchronous_sgd,
+    zero_sharded,
 )
 
 __all__ = [
     "adaptive_sgd",
     "synchronous_averaging",
     "synchronous_sgd",
+    "zero_sharded",
 ]

@@ -38,6 +38,83 @@ def synchronous_sgd(base: optax.GradientTransformation, axis_name: str = "dp") -
     return optax.GradientTransformation(init, update)
 
 
+class _ZeroState(NamedTuple):
+    base: optax.OptState
+
+
+def zero_sharded(
+    base: optax.GradientTransformation,
+    axis_size: int,
+    axis_name: str = "dp",
+) -> optax.GradientTransformation:
+    """ZeRO-1 sharded weight update on the device plane (ISSUE 11;
+    "Automatic Cross-Replica Sharding of Weight Update in Data-Parallel
+    Training", arXiv:2004.13336): gradients are reduce-scattered
+    (`lax.psum_scatter`) so each replica averages only its 1/k shard,
+    the base optimizer updates that shard — its state exists for the
+    shard only, the k-fold state/FLOP cut — and the updated parameters
+    are re-assembled with `lax.all_gather`. The returned updates equal
+    S-SGD's up to float reassociation (psum_scatter associates like
+    psum), at 1/k optimizer state and update FLOPs per replica.
+
+    Each leaf is flattened and zero-padded to a multiple of
+    ``axis_size`` (the mapped axis size, passed explicitly so state
+    shapes are static); padding lanes carry zero gradients, so
+    stateful base transforms see zeros there on every replica alike.
+    Like the other wrappers this must run inside a `shard_map` over
+    `axis_name` — init() included, since each replica initializes state
+    for ITS shard (use out_specs ``P(axis_name)`` on the state so the
+    global view concatenates the shards)."""
+    k = int(axis_size)
+    if k < 1:
+        raise ValueError(f"axis_size must be >= 1, got {axis_size}")
+
+    def _shard_len(n: int) -> int:
+        return -(-n // k)
+
+    def _pad_flat(leaf):
+        flat = leaf.reshape(-1)
+        m = _shard_len(flat.size)
+        return jnp.pad(flat, (0, m * k - flat.size)), m
+
+    def _my_shard(leaf):
+        padded, m = _pad_flat(leaf)
+        idx = lax.axis_index(axis_name)
+        return lax.dynamic_slice(padded, (idx * m,), (m,))
+
+    def init(params):
+        return _ZeroState(base=base.init(jax.tree.map(_my_shard, params)))
+
+    def update(grads, state, params=None, **extra):
+        if params is None:
+            raise ValueError("zero_sharded requires params")
+        # reduce-scatter + average: each replica holds the mean of its
+        # 1/k gradient shard (psum_scatter of the padded flat leaf)
+        def g_shard(g):
+            padded, _ = _pad_flat(g)
+            return lax.psum_scatter(
+                padded, axis_name, scatter_dimension=0, tiled=True
+            ) / k
+
+        grad_shards = jax.tree.map(g_shard, grads)
+        param_shards = jax.tree.map(_my_shard, params)
+        shard_updates, base_state = base.update(
+            grad_shards, state.base, param_shards, **extra
+        )
+        new_shards = optax.apply_updates(param_shards, shard_updates)
+
+        # all-gather the updated shards and express the result as an
+        # optax update (new - old), unpadded and reshaped per leaf
+        def regather(new_shard, p):
+            full = lax.all_gather(new_shard, axis_name, tiled=True)
+            return full[: p.size].reshape(p.shape) - p
+
+        updates = jax.tree.map(regather, new_shards, params)
+        return updates, _ZeroState(base=base_state)
+
+    return optax.GradientTransformation(init, update)
+
+
 class _SMAState(NamedTuple):
     base: optax.OptState
 

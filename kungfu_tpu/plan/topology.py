@@ -175,6 +175,21 @@ class SegmentedSchedule:
         return (self.index + 1) % self.k
 
 
+def owned_segment_bounds(count: int, k: int, index: int) -> Tuple[int, int]:
+    """(begin, end) element bounds of the segment ring member ``index``
+    owns fully reduced after the reduce-scatter phase — THE shard layout
+    of the ZeRO-1 sharded update (ISSUE 11). Single-sourced here so the
+    walk engine's segment math and the sharded optimizer's shard views
+    can never disagree: both call this, both get
+    ``even_partition(count, k)[owned_segment]``. k == 1 owns everything."""
+    from kungfu_tpu.base.workspace import even_partition
+
+    if k <= 1:
+        return (0, count)
+    sched = gen_segmented_schedule(list(range(k)), index)
+    return even_partition(count, k)[sched.owned_segment]
+
+
 def gen_segmented_schedule(ranks: List[int], index: int) -> SegmentedSchedule:
     """Segmented ring schedule for member ``index`` of ``ranks``.
 

@@ -274,6 +274,210 @@ class SynchronousSGDOptimizer:
         return getattr(self.base, item)
 
 
+class ZeroSGDOptimizer:
+    """ZeRO-1 sharded S-SGD for torch over the host plane (ISSUE 11):
+    gradients are reduce-scattered around the ring, ``step()`` runs SGD
+    on — and holds momentum state plus f32 master weights for — ONLY
+    this rank's 1/k shard, and an all-gather of updated weights (bf16 on
+    the wire when ``KF_CONFIG_WIRE`` is active) lands the result back in
+    the param tensors in place. Optimizer state and update FLOPs drop
+    k-fold vs :class:`SynchronousSGDOptimizer`.
+
+    This optimizer OWNS the SGD math (``lr``/``momentum``, the torch-SGD
+    formula ``buf = m·buf + g; p -= lr·buf``) rather than wrapping a
+    base ``torch.optim`` instance — a base optimizer would allocate
+    full-size state, which is exactly what sharding removes.
+
+    With ``KF_CONFIG_ZERO`` resolving off — or a cluster of one — it
+    falls back to the replicated path (``sync_gradients`` + the same
+    formula on full params, full-size state), so ``zero`` A/Bs by knob;
+    for plain SGD on exact payloads the two paths are bit-identical.
+    With the async scheduler on, gradients are submitted per tensor and
+    the weight all-gathers pipeline across buckets; ``step()`` returns
+    with params fully updated (the forward that follows needs them).
+
+    Elastic resize: shard ownership is a function of k — call
+    ``export_state()`` BEFORE the resize and ``rebuild(blob)`` after
+    (see ShardedUpdateSession). CPU-tensor first like the rest of the
+    frontend: param/grad views cross the numpy bridge zero-copy there;
+    non-CPU params are copied back after each step."""
+
+    def __init__(self, module_or_params, lr: float, momentum: float = 0.0,
+                 name: str = "zsgd"):
+        self.lr = float(lr)
+        self.momentum = float(momentum)
+        self.name = name
+        self._params = [
+            p for p in _params_of(module_or_params) if p.requires_grad
+        ]
+        if not self._params:
+            raise ValueError("ZeroSGDOptimizer needs at least one param")
+        self._mode: Optional[str] = None  # decided at first step
+        self._views: List[np.ndarray] = []
+        self._zs = None  # ShardedUpdateSession (sharded mode)
+        self._repl_opt = None  # ShardedSGD over FULL params (fallback)
+        self._repl_state: List[dict] = []
+        self._step = 0
+
+    def _build(self) -> None:
+        self.rebuild(None)
+
+    def state_bytes(self) -> int:
+        """Optimizer-held bytes on this peer: ~1/k of the replicated
+        path in sharded mode (the `kungfu_sharded_update_state_bytes`
+        story the bench reports)."""
+        if self._mode is None:
+            self._build()
+        if self._zs is not None:
+            return self._zs.state_bytes()
+        return sum(
+            a.nbytes for st in self._repl_state for a in st.values()
+        )
+
+    def _bucket_layout(self, sess):
+        from kungfu_tpu.collective.zero import bucket_layout
+
+        return bucket_layout(
+            [v.size for v in self._views], sess.GROUP_BUCKET_BYTES
+        )
+
+    def export_state(self) -> bytes:
+        """Full optimizer state as one exact blob (every peer gets the
+        identical bytes) — run BEFORE a resize, then `rebuild(blob)` on
+        the new epoch. BOTH modes serialize the same canonical
+        bucket-shaped layout (per bucket: full f32 masters, then each
+        state leaf — the `bucket_layout` of the param sizes under the
+        cluster-agreed byte cap), so a resize that flips the resolved
+        KF_CONFIG_ZERO mode (e.g. `auto` shrinking to one peer) can
+        still restore the other mode's blob."""
+        if self._mode is None:
+            self._build()
+        if self._zs is not None:
+            return self._zs.export_state()
+        from kungfu_tpu.base.serialize import pack_leaves
+
+        sess = api.get_default_peer().current_session()
+        names = self._repl_opt.state_names()
+        leaves = []
+        for idxs in self._bucket_layout(sess):
+            # replicated mode's masters ARE the current params
+            leaves.append(np.concatenate([self._views[i] for i in idxs]))
+            for k in names:
+                leaves.append(np.concatenate(
+                    [self._repl_state[i][k] for i in idxs]
+                ))
+        return pack_leaves(leaves)
+
+    def rebuild(self, restore_state: Optional[bytes] = None) -> None:
+        """(Re-)bind to the CURRENT session epoch — called lazily at the
+        first step, and explicitly after an elastic resize with an
+        `export_state` blob from before it, re-sharding (or
+        de-sharding: the resolved mode may flip across the resize)
+        optimizer state so zero-step-loss resizes hold."""
+        from kungfu_tpu.collective.zero import ShardedSGD, ShardedUpdateSession
+
+        sess = api.get_default_peer().current_session()
+        self._views = [_flat_view(p) for p in self._params]
+        if sess.zero_enabled():
+            self._mode = "sharded"
+            self._zs = ShardedUpdateSession(
+                self._views, ShardedSGD(self.lr, self.momentum),
+                name=self.name, session=sess, restore_state=restore_state,
+            )
+            self._repl_opt = None
+            self._repl_state = []
+            self._writeback()
+            return
+        self._mode = "replicated"
+        self._zs = None
+        self._repl_opt = ShardedSGD(self.lr, self.momentum)
+        self._repl_state = [self._repl_opt.init(v.size) for v in self._views]
+        if restore_state is not None:
+            from kungfu_tpu.base.serialize import unpack_leaves
+
+            names = self._repl_opt.state_names()
+            layout = self._bucket_layout(sess)
+            leaves = unpack_leaves(restore_state, (1 + len(names)) * len(layout))
+            it = iter(leaves)
+            for idxs in layout:
+                # canonical layout (see export_state): masters refresh
+                # the params, state leaves split back per param
+                master = np.asarray(next(it), np.float32).reshape(-1)
+                off = 0
+                for i in idxs:
+                    np.copyto(self._views[i], master[off:off + self._views[i].size])
+                    off += self._views[i].size
+                for k in names:
+                    full = np.asarray(next(it), np.float32).reshape(-1)
+                    off = 0
+                    for i in idxs:
+                        np.copyto(self._repl_state[i][k],
+                                  full[off:off + self._views[i].size])
+                        off += self._views[i].size
+            self._writeback()
+
+    def _writeback(self) -> None:
+        """Non-CPU / non-contiguous params: the numpy views are copies,
+        push the updated values back into the tensors."""
+        for p, v in zip(self._params, self._views):
+            if p.device.type != "cpu" or not p.data.is_contiguous():
+                with_no_grad_copy(p, v)
+
+    def zero_grad(self) -> None:
+        for p in self._params:
+            if p.grad is not None:
+                p.grad.detach_()
+                p.grad.zero_()
+
+    def step(self, closure=None):
+        loss = closure() if closure is not None else None
+        if self._mode is None:
+            self._build()
+        grads = []
+        for i, p in enumerate(self._params):
+            if p.grad is None:
+                raise RuntimeError(
+                    f"param {i} has no gradient — ZeroSGDOptimizer "
+                    "requires every registered param to receive a grad "
+                    "each step (the sharded bucket layout is fixed)"
+                )
+            grads.append(_flat_view(p.grad))
+        if self._zs is not None:
+            sess = api.get_default_peer().current_session()
+            if sess.async_enabled():
+                for i, g in enumerate(grads):
+                    self._zs.submit_grad(i, g)
+                self._zs.flush()
+                # params feed the forward right after step() returns:
+                # wait for the tail all-gathers here (the pipelining
+                # already overlapped them with later buckets' updates)
+                self._zs.wait_params()
+            else:
+                self._zs.step(grads)
+        else:
+            # replicated fallback: averaged grads (in place), then the
+            # identical SGD formula on full params with full-size state
+            if api.cluster_size() > 1:
+                sync_gradients(self._params, name=f"{self.name}:{self._step}",
+                               _force_sync_engine=True)
+                # non-CPU grads: sync_gradients wrote the averages back
+                # into p.grad, so the pre-sync copies above are stale
+                grads = [_flat_view(p.grad) for p in self._params]
+            for v, g, st in zip(self._views, grads, self._repl_state):
+                self._repl_opt.apply(v, g, st, 1.0)
+        self._writeback()
+        self._step += 1
+        return loss
+
+
+def with_no_grad_copy(p, arr: np.ndarray) -> None:
+    """p.copy_(arr) under no_grad, inverting the bf16 bridge."""
+    import torch
+
+    with torch.no_grad():
+        p.copy_(_to_torch(arr).view_as(p))
+
+
 class PairAveragingOptimizer:
     """AD-PSGD for torch (parity: PairAveragingOptimizer): apply the local
     step, then average parameters 0.5/0.5 with a random peer's published

@@ -26,6 +26,8 @@ def main() -> None:
         argv += ["--wire-ab"]
     if os.environ.get("KF_BENCH_ASYNC", ""):
         argv += ["--async"]
+    if os.environ.get("KF_BENCH_ZERO", ""):
+        argv += ["--zero"]
     sys.argv = argv
     from kungfu_tpu.benchmarks.__main__ import main as bench_main
 
