@@ -445,17 +445,30 @@ def minimum_spanning_tree(weights) -> list:
     return _mst(weights)
 
 
+_latency_probe_seq: dict = {}  # cluster version -> probes this epoch
+
+
 def optimized_tree(samples: int = 3) -> list:
     """Probe latencies, allgather rows into the full matrix, and return the
     MST father array — identical on every peer (deterministic MST over the
     consensus matrix), ready for set_tree."""
     from kungfu_tpu.monitor.latency import latency_matrix_from_rows
 
-    sess = get_default_peer().current_session()
+    peer = get_default_peer()
+    sess = peer.current_session()
     n = sess.size
     row = get_peer_latencies(samples)
     recv = np.zeros(n * n, np.float64)
-    w = Workspace(send=row, recv=recv, op=ReduceOp.SUM, name="kungfu::latency")
+    # KF700: back-to-back probes must not share a rendezvous name. The
+    # counter is PER CLUSTER VERSION, not process-lifetime: a joiner's
+    # process starts at 0 while survivors have probed for epochs — only
+    # within one epoch do peers call in identical program order, so only
+    # the (version, calls-this-version) pair agrees cluster-wide
+    v = peer.cluster_version
+    seq = _latency_probe_seq.get(v, 0)
+    _latency_probe_seq[v] = seq + 1
+    w = Workspace(send=row, recv=recv, op=ReduceOp.SUM,
+                  name=f"kungfu::latency:v{v}:{seq}")
     sess.all_gather(w)
     matrix = latency_matrix_from_rows(list(recv.reshape(n, n)))
     return minimum_spanning_tree(matrix)

@@ -242,6 +242,16 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         # scores walks against; the sampler thins per-step spans
         self._links = tlink.get_table() if tlink.enabled() else None
         self._span_sampler = SpanSampler(tconfig.span_sample())
+        # collective-order sentinel (ISSUE 12): with the debug knob set,
+        # protowatch wraps this instance's public entry points at bind
+        # time. Unset = the module is never imported and the methods stay
+        # the plain class functions — zero hot-path cost (asserted by
+        # tests/test_protowatch.py, like lockwatch)
+        self._protowatch = None
+        if knobs.get("KF_DEBUG_PROTOCOL"):
+            from kungfu_tpu.devtools import protowatch
+
+            protowatch.attach(self)
 
     def _candidate(self, idx: int) -> List[st.StrategyPair]:
         if idx not in self._candidates_built:
@@ -302,6 +312,10 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
                         "session's scheduler"
                     )
                 self._scheduler = CollectiveScheduler(self)
+                if self._protowatch is not None:
+                    from kungfu_tpu.devtools import protowatch
+
+                    protowatch.attach_scheduler(self._scheduler)
             return self._scheduler
 
     def close(self, timeout: Optional[float] = None) -> None:

@@ -194,8 +194,15 @@ class GroupFusion:
         )
         return (fused, send_b, recv_b, members)
 
-    def _unpack_bucket(self, item) -> None:
+    def _unpack_bucket(self, item, abort: Optional[threading.Event] = None) -> None:
         fused, send_b, recv_b, members, deferred = item
+        if abort is not None and abort.is_set():
+            # KF703: the group/scheduler scope aborted while this bucket
+            # was in flight — the member recv buffers may already be
+            # reused by the caller that raised, so drop the bucket (its
+            # pooled staging goes to GC, the pool's policy for buffers a
+            # worker may still touch)
+            return
         pool = get_buffer_pool()
         try:
             with trace.span("host.fuse.unpack"):
@@ -288,7 +295,7 @@ class GroupFusion:
                         return
                     if abort.is_set():
                         continue  # aborted: must not touch caller buffers
-                    self._unpack_bucket(item)
+                    self._unpack_bucket(item, abort)
             except BaseException:
                 abort.set()
                 raise

@@ -746,7 +746,7 @@ class CollectiveScheduler:
                 t0 = time.perf_counter()
                 if unit.kind == "zero":
                     with trace.span("sched.unpack", unit=unit.index):
-                        self._handler.scatter(item)
+                        self._handler.scatter(item, cancel=self._abort)
                     self._add_busy(time.perf_counter() - t0, queued=-1)
                     with self._cond:
                         self._gather_outstanding -= 1
@@ -756,7 +756,7 @@ class CollectiveScheduler:
                     continue
                 if unit.fused:
                     with trace.span("sched.unpack", unit=unit.index):
-                        self.sess._unpack_bucket(item)
+                        self.sess._unpack_bucket(item, self._abort)
                 else:
                     # single: the walk wrote w.recv in place (the
                     # wrapper workspace shares the caller's buffers);

@@ -571,6 +571,12 @@ class WalkEngine:
                 encode_wire(wirearr[ob:oe], acc[ob:oe], wire)
         for s, (snd, rcv) in enumerate(sched.ag_steps):
             timed_step("host.ag.step", "ag", s, snd, rcv)
+        if cancel is not None and cancel.is_set():
+            # KF703: a sibling in the group scope timed out while our
+            # steps completed — acc may belong to a caller that already
+            # raised, so observe the abort before the walk-end decode
+            # writes it (wirebuf deliberately leaks, pool policy)
+            raise TimeoutError(f"collective cancelled: {w.name}")
         deferred: Optional[DeferredDecode] = None
         if wire is not None:
             if defer_decode:
@@ -873,6 +879,11 @@ class WalkEngine:
                     if prof is not None:
                         prof.wait += time.perf_counter() - _t_recv
                 send_all(nexts, Flags.WAIT_RECV_BUF)
+        if cancel.is_set():
+            # KF703: the group scope aborted while this walk's own edges
+            # completed — w.recv may already be reused by the caller that
+            # raised, so the root's codec roundtrip below must not touch it
+            raise TimeoutError(f"collective cancelled: {w.name}")
         if wire is not None and not graphs[-1].prevs(self.rank):
             # the bcast root never receives a wire message, so it would
             # keep its full-precision f32 result while every other peer

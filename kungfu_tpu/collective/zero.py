@@ -546,11 +546,18 @@ class ShardedUpdateSession:
         )
         return item
 
-    def scatter(self, item: _ZeroItem) -> None:
+    def scatter(self, item: _ZeroItem,
+                cancel: Optional[threading.Event] = None) -> None:
         """Unpack stage: scatter the gathered weights back into the
         caller's param views (in place — torch tensors see the update
         without a copy), then release the bucket's `settled` gate so the
-        next round's update may write the mirror."""
+        next round's update may write the mirror. A set `cancel`
+        (scheduler hard-abort) skips the write — the epoch is ending and
+        the params are restored by the elastic state sync, so a late
+        scatter must not race the caller (KF703); the `settled` gate
+        stays cleared, matching the driver's skip path."""
+        if cancel is not None and cancel.is_set():
+            return
         b = self._buckets[item.zindex]
         with trace.span("zero.scatter", bucket=item.zindex):
             for j, p in enumerate(b.params):
@@ -615,6 +622,10 @@ class ShardedUpdateSession:
                     b.master = full[b.ob:b.oe].copy()
                     for j, p in enumerate(b.params):
                         off = b.offsets[j]
+                        # kfcheck: disable=KF703 — constructor-time
+                        # restore: no walk is in flight yet, so no abort
+                        # scope exists; the params are the caller's to
+                        # initialize before the first step
                         np.copyto(p, b.W[off:off + b.sizes[j]])
                 else:
                     np.copyto(b.state[name], full[b.ob:b.oe])

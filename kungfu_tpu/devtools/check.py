@@ -1,0 +1,68 @@
+"""Single devtools gate: ``python -m kungfu_tpu.devtools.check``.
+
+One command, one exit code, every project invariant (ISSUE 12
+satellite). CI and the tier-1 gate used to make three separate
+invocations — kfcheck over the tree, the docs/knobs.md byte-compare,
+the metric-doc lint — each with its own entry point and failure shape.
+All three are kfcheck rules today, so this driver runs the full rule
+set ONCE (per-file cache and all) and sections the report by concern:
+
+- ``[kfcheck]``      the code rules (KF0xx–KF5xx, KF7xx)
+- ``[knobs-doc]``    docs/knobs.md vs the knob registry (KF102)
+- ``[metric-docs]``  docs/telemetry.md vs registered families (KF600/601)
+
+Exit status is the contract — 0 clean, 1 findings — matching the
+kfcheck CLI. ``tests/test_kfcheck.py`` invokes it as the tier-1 gate;
+the historical shims (tests/test_metrics_doc_lint.py,
+tests/test_no_bare_print.py) keep their names but all ride this one
+driver.
+"""
+
+from __future__ import annotations
+
+import argparse
+import sys
+from typing import List
+
+from kungfu_tpu.devtools.kfcheck import core
+
+_DOC_RULES_KNOBS = ("KF102",)
+_DOC_RULES_METRICS = ("KF600", "KF601")
+
+
+def _section(findings: List["core.Finding"], title: str, rules) -> List[str]:
+    hits = [f for f in findings if f.rule in rules] if rules else findings
+    lines = [f"[{title}] {'clean' if not hits else f'{len(hits)} finding(s)'}"]
+    lines.extend("  " + f.render() for f in hits)
+    return lines
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser(
+        prog="python -m kungfu_tpu.devtools.check",
+        description="the whole devtools gate in one invocation: kfcheck "
+        "rules, knobs-doc staleness, metric-doc lint (exit 0 = clean)",
+    )
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the kfcheck per-file result cache")
+    args = p.parse_args(argv)
+
+    core._ensure_rules_loaded()
+    findings = core.run_project(use_cache=not args.no_cache)
+    doc_rules = set(_DOC_RULES_KNOBS) | set(_DOC_RULES_METRICS)
+    code = [f for f in findings if f.rule not in doc_rules]
+    out: List[str] = []
+    out.extend(_section(code, "kfcheck", None))
+    out.extend(_section(findings, "knobs-doc", _DOC_RULES_KNOBS))
+    out.extend(_section(findings, "metric-docs", _DOC_RULES_METRICS))
+    n = len(findings)
+    out.append(
+        "check: clean" if n == 0
+        else f"check: {n} finding{'s' if n != 1 else ''}"
+    )
+    sys.stdout.write("\n".join(out) + "\n")
+    return 1 if findings else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -18,6 +18,10 @@ Rule families (see docs/devtools.md):
 - KF4xx  exception hygiene (no silent broad excepts)
 - KF5xx  CLI surface (no bare print outside cli/info)
 - KF6xx  telemetry docs (metric families documented, no ghost rows)
+- KF7xx  distributed protocol (ISSUE 12, the first cross-module rules:
+         wire-name discipline, knob-consensus coverage, collective
+         symmetry, caller-buffer ownership) — paired with the runtime
+         collective-order sentinel, devtools/protowatch.py
 
 Suppression format, enforced::
 

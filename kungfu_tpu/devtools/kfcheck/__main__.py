@@ -38,6 +38,10 @@ def main(argv=None) -> int:
                    "stale-suppression findings are skipped for subsets)")
     p.add_argument("--list-rules", action="store_true",
                    help="print every rule id + description and exit")
+    p.add_argument("--no-cache", action="store_true",
+                   help="bypass the per-file result cache "
+                   "(.kfcheck-cache.json): re-parse and re-analyze "
+                   "every file")
     p.add_argument("--write-knobs-doc", action="store_true",
                    help="regenerate docs/knobs.md from the knob registry "
                    "and exit")
@@ -69,7 +73,7 @@ def main(argv=None) -> int:
             )
             return 2
 
-    findings = core.run_project(select=select)
+    findings = core.run_project(select=select, use_cache=not args.no_cache)
     if args.json:
         sys.stdout.write(core.to_json(findings))
     else:

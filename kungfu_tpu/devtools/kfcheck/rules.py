@@ -20,6 +20,23 @@ Static limits, stated rather than hidden:
 - KF300 accepts a thread as "provably joined" when the same module
   joins a receiver of the same name with a bounded timeout; it does not
   do interprocedural dataflow.
+- KF700 sees names the call site *spells*: literals, module constants,
+  constant-folded concatenations and f-strings without interpolation
+  are findings; any interpolated f-string passes, even one whose
+  interpolated parts are round-invariant. The runtime sentinel
+  (devtools/protowatch.py) covers that blind spot — like KF2xx and
+  lockwatch, the two layers are complementary.
+- KF702 is the *lexical shadow* of the registration-divergence runtime
+  error: it sees rank conditionals whose test names rank/identity
+  attributes and collective calls spelled as method calls in either
+  branch. Point-to-point traffic (client.send / endpoint.recv) is
+  deliberately out of scope — send/recv asymmetry under a rank guard is
+  how rooted walks are built.
+- KF703 recognizes caller-owned buffers by the module's own naming
+  conventions (`.recv` workspace fields, the segmented walk's `acc`
+  alias, loop variables iterating `.params`) and abort scopes by name
+  (`cancel`/`abort`/`_abort`); a buffer aliased to an arbitrary name is
+  invisible.
 """
 
 from __future__ import annotations
@@ -30,28 +47,18 @@ import re
 from typing import Dict, Iterable, List, Optional, Sequence, Set, Tuple
 
 from kungfu_tpu.devtools.kfcheck.core import (
+    KNOB_RE,
     FileContext,
     Finding,
     Project,
+    _attr_chain,
     rule,
 )
 
 # ---------------------------------------------------------------------
-# shared AST helpers
+# shared AST helpers (chain resolution lives in core — the fact
+# extractor and the rules must agree on what an expression names)
 # ---------------------------------------------------------------------
-
-
-def _attr_chain(node: ast.AST) -> Optional[str]:
-    """Dotted name for Name/Attribute chains ("os.environ.get"), else
-    None."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
 
 
 def _last_segment(node: ast.AST) -> Optional[str]:
@@ -98,10 +105,6 @@ def _module_basename(relpath: str) -> str:
 # KF1xx — config registry
 # ---------------------------------------------------------------------
 
-# a whole-string knob name: KF_WIRE, KF_CONFIG_ALGO ... but not the bare
-# "KF_"/"KF_CONFIG_" prefixes used for startswith() filters
-KNOB_RE = re.compile(r"^KF_[A-Z0-9_]*[A-Z0-9]$")
-
 # the registry itself is the only place allowed to spell environ
 # plumbing for knobs
 _REGISTRY_FILE = "kungfu_tpu/knobs.py"
@@ -111,6 +114,39 @@ def _declared_knobs() -> Set[str]:
     from kungfu_tpu import knobs
 
     return set(knobs.names())
+
+
+def _cross_constants(project: Project) -> Dict[str, Dict[str, str]]:
+    """module-basename -> {CONST: value} for `flight.DIR_ENV`-style
+    cross-module constant resolution (from the per-file facts)."""
+    cross: Dict[str, Dict[str, str]] = {}
+    for ctx in project.files:
+        cross.setdefault(_module_basename(ctx.relpath), {}).update(
+            ctx.str_constants
+        )
+    return cross
+
+
+def _resolve_desc(
+    desc: dict,
+    ctx: FileContext,
+    cross: Dict[str, Dict[str, str]],
+) -> Optional[str]:
+    """Constant value of a cached name/key descriptor (see
+    core._name_desc), or None when it carries runtime content."""
+    t = desc.get("t")
+    if t == "const":
+        return desc["v"]
+    if t == "name":
+        if desc["v"] in ctx.str_constants:
+            return ctx.str_constants[desc["v"]]
+        imp = ctx.imported_names.get(desc["v"])
+        if imp is not None:
+            return cross.get(imp[0], {}).get(imp[1])
+        return None
+    if t == "attr":
+        return cross.get(desc["base"], {}).get(desc["attr"])
+    return None
 
 
 @rule(
@@ -127,48 +163,15 @@ def check_knob_declared(project: Project) -> List[Finding]:
     for ctx in project.files:
         if ctx.relpath == _REGISTRY_FILE:
             continue
-        for node in ctx.walk():
-            if not (isinstance(node, ast.Constant)
-                    and isinstance(node.value, str)):
-                continue
-            if KNOB_RE.match(node.value) and node.value not in declared:
+        for lineno, literal in ctx.knob_literals:
+            if literal not in declared:
                 out.append(Finding(
-                    "KF100", ctx.relpath, node.lineno,
-                    f"KF_* literal {node.value!r} is not declared in the "
+                    "KF100", ctx.relpath, lineno,
+                    f"KF_* literal {literal!r} is not declared in the "
                     "knob registry (kungfu_tpu/knobs.py) — declare it "
                     "with a default, parser and doc string",
                 ))
     return out
-
-
-def _environ_read_key(node: ast.Call) -> Optional[ast.expr]:
-    """The key expression when `node` reads the environment
-    (os.environ.get / os.getenv), else None."""
-    chain = _attr_chain(node.func)
-    if chain in ("os.environ.get", "environ.get", "os.getenv", "getenv"):
-        return node.args[0] if node.args else None
-    return None
-
-
-def _resolve_key(
-    expr: Optional[ast.expr],
-    ctx: FileContext,
-    cross: Dict[str, Dict[str, str]],
-) -> Optional[str]:
-    if expr is None:
-        return None
-    if isinstance(expr, ast.Constant) and isinstance(expr.value, str):
-        return expr.value
-    if isinstance(expr, ast.Name):
-        if expr.id in ctx.str_constants:
-            return ctx.str_constants[expr.id]
-        imp = ctx.imported_names.get(expr.id)
-        if imp is not None:
-            return cross.get(imp[0], {}).get(imp[1])
-        return None
-    if isinstance(expr, ast.Attribute) and isinstance(expr.value, ast.Name):
-        return cross.get(expr.value.id, {}).get(expr.attr)
-    return None
 
 
 @rule(
@@ -180,32 +183,16 @@ def _resolve_key(
     scope="project",
 )
 def check_env_reads(project: Project) -> List[Finding]:
-    # module-basename -> {CONST: value} for `flight.DIR_ENV`-style keys
-    cross: Dict[str, Dict[str, str]] = {}
-    for ctx in project.files:
-        cross.setdefault(_module_basename(ctx.relpath), {}).update(
-            ctx.str_constants
-        )
+    cross = _cross_constants(project)
     out = []
     for ctx in project.files:
         if ctx.relpath == _REGISTRY_FILE:
             continue
-        for node in ctx.walk():
-            key = None
-            if isinstance(node, ast.Call):
-                key = _environ_read_key(node)
-            elif (
-                isinstance(node, ast.Subscript)
-                and isinstance(node.ctx, ast.Load)
-                and _attr_chain(node.value) in ("os.environ", "environ")
-            ):
-                key = node.slice
-            if key is None:
-                continue
-            resolved = _resolve_key(key, ctx, cross)
+        for lineno, desc in ctx.env_reads:
+            resolved = _resolve_desc(desc, ctx, cross)
             if resolved is not None and resolved.startswith("KF_"):
                 out.append(Finding(
-                    "KF101", ctx.relpath, node.lineno,
+                    "KF101", ctx.relpath, lineno,
                     f"direct environment read of {resolved!r} — go "
                     "through kungfu_tpu.knobs (get/raw/is_set) so "
                     "parsing, defaults and docs stay single-sourced",
@@ -585,10 +572,14 @@ def check_unbounded_join(ctx: FileContext) -> List[Finding]:
 # the modules that run background stages against a session epoch: their
 # threads MUST register with the abort protocol (a declared joinable
 # set that close() joins), or a forgotten stage outlives the epoch and
-# keeps walking against a dead transport token
+# keeps walking against a dead transport token. zero.py joined the set
+# in ISSUE 12: today its settled-gate polling and gather-stage work run
+# ON the scheduler's registered threads, and a future helper thread
+# must not slip in unregistered.
 _KF303_MODULES = (
     "kungfu_tpu/collective/scheduler.py",
     "kungfu_tpu/collective/pipeline.py",
+    "kungfu_tpu/collective/zero.py",
 )
 
 _KF303_FACTORY = "_spawn_registered"
@@ -922,5 +913,383 @@ def check_metric_ghosts(project: Project) -> List[Finding]:
                     "KF601", "docs/telemetry.md", lineno,
                     f"docs/telemetry.md documents {doc_name!r} but no "
                     "code registers it — drop the stale row",
+                ))
+    return out
+
+
+# ---------------------------------------------------------------------
+# KF7xx — distributed protocol (ISSUE 12: the first cross-module rules)
+# ---------------------------------------------------------------------
+
+# where the registry-declared consensus knobs must surface as the
+# engine's consensus tuple (HostSession.engine_knobs)
+_CONSENSUS_FILE = "kungfu_tpu/collective/host_session.py"
+_CONSENSUS_FN = "engine_knobs"
+
+
+@rule(
+    "KF700",
+    "wire-name-discipline",
+    "every name reaching a collective/submit call site (Workspace name, "
+    "all_gather_shards/broadcast_bytes/bytes_consensus names, barrier "
+    "tags) must carry runtime content — a round/sequence stamp, a "
+    "cluster version, the registered identity. A bare string literal "
+    "rendezvous name collides across back-to-back rounds: a fast peer's "
+    "round r+1 message is consumed by a slow peer still in round r "
+    "(the PR 8 ':{i}@{seq}' fix, enforced instead of remembered)",
+    scope="project",
+)
+def check_wire_names(project: Project) -> List[Finding]:
+    cross = _cross_constants(project)
+    out = []
+    for ctx in project.files:
+        for lineno, site, desc in ctx.name_sites:
+            resolved = _resolve_desc(desc, ctx, cross)
+            if resolved is None:
+                continue  # interpolated / runtime-derived: passes
+            out.append(Finding(
+                "KF700", ctx.relpath, lineno,
+                f"constant wire name {resolved!r} at a {site} call site "
+                "— a name without a round/sequence stamp can collide "
+                "across back-to-back rounds (a fast peer's next round is "
+                "consumed by a slow peer's current one); stamp it with a "
+                "round counter, cluster version or registered identity",
+            ))
+    return out
+
+
+def _knob_registry_decls(ctx: FileContext) -> Dict[str, Tuple[int, bool]]:
+    """name -> (lineno, consensus flag) for every `_knob("NAME", ...)`
+    declaration in the registry file (AST, not import: fixtures supply
+    their own registry source)."""
+    decls: Dict[str, Tuple[int, bool]] = {}
+    for node in ctx.walk():
+        if not isinstance(node, ast.Call):
+            continue
+        if _last_segment(node.func) != "_knob":
+            continue
+        if not (node.args and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)):
+            continue
+        consensus = _is_true(_kw(node, "consensus"))
+        decls[node.args[0].value] = (node.lineno, consensus)
+    return decls
+
+
+def _consensus_tuple_entries(ctx: FileContext) -> List[Tuple[str, int]]:
+    """(knob name, lineno) for every literal-named entry of the list
+    `engine_knobs()` returns."""
+    entries: List[Tuple[str, int]] = []
+    for node in ctx.walk():
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == _CONSENSUS_FN):
+            continue
+        for sub in ast.walk(node):
+            if not isinstance(sub, ast.Return) or sub.value is None:
+                continue
+            for elt in ast.walk(sub.value):
+                if (
+                    isinstance(elt, ast.Tuple)
+                    and elt.elts
+                    and isinstance(elt.elts[0], ast.Constant)
+                    and isinstance(elt.elts[0].value, str)
+                ):
+                    entries.append((elt.elts[0].value, elt.lineno))
+    return entries
+
+
+@rule(
+    "KF701",
+    "consensus-coverage",
+    "the knob registry's consensus flags and the engine's consensus "
+    "tuple (HostSession.engine_knobs) must agree exactly: a knob "
+    "declared consensus=True but absent from the tuple would let peers "
+    "resolve divergent walk-layout/codec values and deadlock on "
+    "rendezvous names the consensus check never compared; a tuple entry "
+    "not flagged in the registry leaves the single source of truth "
+    "lying. The registry is authoritative — flag the knob there, cover "
+    "it in engine_knobs(), or do neither",
+    scope="project",
+)
+def check_consensus_coverage(project: Project) -> List[Finding]:
+    reg_ctx = sess_ctx = None
+    for ctx in project.files:
+        if ctx.relpath == _REGISTRY_FILE:
+            reg_ctx = ctx
+        elif ctx.relpath == _CONSENSUS_FILE:
+            sess_ctx = ctx
+    if reg_ctx is None:
+        return []  # not a tree with a knob registry (fixture subsets)
+    decls = _knob_registry_decls(reg_ctx)
+    consensus_decls = {
+        name: line for name, (line, flag) in decls.items() if flag
+    }
+    if sess_ctx is None:
+        if not consensus_decls:
+            return []
+        return [Finding(
+            "KF701", _REGISTRY_FILE, 1,
+            f"registry declares {len(consensus_decls)} consensus knobs "
+            f"but {_CONSENSUS_FILE} (the engine_knobs() consensus tuple) "
+            "is missing from the analyzed tree — the coverage "
+            "cross-check cannot run",
+        )]
+    entries = _consensus_tuple_entries(sess_ctx)
+    if not entries:
+        # the scan must keep finding the tuple — a rename must not
+        # silently turn this rule into a no-op
+        return [Finding(
+            "KF701", _CONSENSUS_FILE, 1,
+            f"no literal-named entries found in {_CONSENSUS_FN}() — the "
+            "consensus-tuple scan looks broken (rename?), fix the rule "
+            "before trusting it",
+        )]
+    covered = {name for name, _ in entries}
+    out = []
+    for name, line in sorted(consensus_decls.items()):
+        if name not in covered:
+            out.append(Finding(
+                "KF701", _REGISTRY_FILE, line,
+                f"knob {name} is declared consensus=True (cluster-"
+                "agreed) but does not appear in the engine_knobs() "
+                f"consensus tuple ({_CONSENSUS_FILE}) — peers could "
+                "resolve divergent values and deadlock on mismatched "
+                "rendezvous names with no fail-fast; add it to the "
+                "tuple",
+            ))
+    for name, line in entries:
+        if name in decls and not decls[name][1]:
+            out.append(Finding(
+                "KF701", _CONSENSUS_FILE, line,
+                f"engine_knobs() covers {name} but the registry does "
+                "not declare it consensus=True — the registry is the "
+                "single source of truth for the cluster-agreed set; "
+                "flag it there (or drop it from the tuple)",
+            ))
+        elif name not in decls:
+            out.append(Finding(
+                "KF701", _CONSENSUS_FILE, line,
+                f"engine_knobs() covers {name!r}, which the knob "
+                "registry does not declare at all",
+            ))
+    return out
+
+
+# the collective rendezvous entry points KF702 treats as "every peer
+# must reach this together": method-call spellings only (module
+# functions like functools.reduce stay out of scope)
+_KF702_COLLECTIVES = frozenset({
+    "all_reduce", "monitored_all_reduce", "group_all_reduce",
+    "cross_all_reduce", "all_gather", "all_gather_shards",
+    "reduce_scatter", "barrier", "bytes_consensus", "broadcast_bytes",
+    "subset_all_reduce", "all_reduce_with", "group_all_reduce_async",
+    "all_reduce_array", "run_barrier", "consensus",
+})
+
+# rank/identity attributes whose comparison marks a branch as
+# peer-asymmetric
+_KF702_IDENTITY = frozenset({
+    "rank", "local_rank", "self_rank", "self_id", "local_size",
+})
+
+
+def _is_rank_test(test: ast.expr) -> bool:
+    for node in ast.walk(test):
+        if isinstance(node, ast.Compare):
+            sides = [node.left] + list(node.comparators)
+            for side in sides:
+                seg = _last_segment(side)
+                if seg in _KF702_IDENTITY:
+                    return True
+    return False
+
+
+def _collective_calls(nodes: Sequence[ast.stmt]) -> List[ast.Call]:
+    out = []
+    for stmt in nodes:
+        for node in ast.walk(stmt):
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr in _KF702_COLLECTIVES
+            ):
+                out.append(node)
+    return out
+
+
+@rule(
+    "KF702",
+    "collective-symmetry",
+    "a collective call lexically guarded by a rank/peer-identity "
+    "conditional with no collective in the counterpart branch means one "
+    "subset of peers enters a rendezvous the rest never will — the "
+    "static shadow of the scheduler's registration-divergence error, "
+    "caught at review time instead of as a hang. Rooted data movement "
+    "belongs in the engine's graph walks (reduce/broadcast/gather take "
+    "a root argument and are called by every peer)",
+)
+def check_collective_symmetry(ctx: FileContext) -> List[Finding]:
+    if ctx.tree is None:
+        return []
+    out = []
+    for node in ctx.walk():
+        if not isinstance(node, ast.If) or not _is_rank_test(node.test):
+            continue
+        body_calls = _collective_calls(node.body)
+        else_calls = _collective_calls(node.orelse)
+        lopsided = None
+        if body_calls and not else_calls:
+            lopsided = body_calls[0]
+        elif else_calls and not body_calls:
+            lopsided = else_calls[0]
+        if lopsided is None:
+            continue
+        out.append(Finding(
+            "KF702", ctx.relpath, lopsided.lineno,
+            f".{lopsided.func.attr}() runs under a rank/identity "
+            f"conditional (line {node.lineno}) whose other branch "
+            "reaches no collective — peers taking the other branch "
+            "never enter this rendezvous and the cluster hangs; make "
+            "both branches collectively symmetric or lift the call out "
+            "of the conditional",
+        ))
+    return out
+
+
+# KF703: caller-owned-buffer mutation discipline for the walk engines.
+# These modules write buffers the CALLER still owns (workspace recv
+# views, torch param views) from background stages; PR 4 established —
+# and PR 9 re-learned — that every such write must be dominated by an
+# abort/cancel check, or a late-arriving stage writes into a buffer the
+# caller already reused after a timeout.
+_KF703_MODULES = (
+    "kungfu_tpu/collective/walks.py",
+    "kungfu_tpu/collective/pipeline.py",
+    "kungfu_tpu/collective/zero.py",
+)
+
+_KF703_ABORT_NAMES = frozenset({"cancel", "abort", "_abort"})
+
+# mutation helpers whose FIRST argument is the destination buffer
+_KF703_WRITE_FNS = frozenset({
+    "copyto", "decode_wire", "decode_accumulate", "reduce_inplace",
+    "reduce_segment", "copy_segment", "transform2", "transform_n",
+    "decode_into",
+})
+
+
+def _own_scope_stmts(fn: ast.AST) -> Iterable[ast.AST]:
+    """Nodes of a function body EXCLUDING nested function/lambda bodies
+    (a nested closure runs under its own abort discipline)."""
+    stack = list(getattr(fn, "body", []))
+    while stack:
+        node = stack.pop()
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            continue
+        yield node
+        stack.extend(ast.iter_child_nodes(node))
+
+
+def _caller_buffer_write(node: ast.AST, param_iters: Set[str]) -> Optional[str]:
+    """A short label when `node` writes a caller-owned buffer, else
+    None. Caller-owned: `<x>.recv` workspace views, the segmented
+    walk's `acc` accumulator alias, and loop variables iterating a
+    `.params` sequence (torch/optimizer views scatter writes back)."""
+    def owned(expr: ast.expr) -> Optional[str]:
+        seg = _last_segment(expr)
+        if seg == "recv":
+            return _attr_chain(expr) or "recv"
+        if isinstance(expr, ast.Name) and (
+            expr.id == "acc" or expr.id in param_iters
+        ):
+            return expr.id
+        if isinstance(expr, ast.Subscript):
+            return owned(expr.value)
+        return None
+
+    if isinstance(node, ast.Call):
+        if _last_segment(node.func) in _KF703_WRITE_FNS and node.args:
+            dst = owned(node.args[0])
+            if dst is not None:
+                return f"{_last_segment(node.func)}({dst}, ...)"
+        return None
+    if isinstance(node, ast.Assign):
+        for tgt in node.targets:
+            if isinstance(tgt, ast.Subscript):
+                dst = owned(tgt.value)
+                if dst is not None:
+                    return f"{dst}[...] = ..."
+    return None
+
+
+@rule(
+    "KF703",
+    "caller-buffer-ownership",
+    "in the walk-engine modules (collective/walks.py, pipeline.py, "
+    "zero.py) every write to a caller-owned buffer (workspace .recv "
+    "views, the segmented accumulator, param views) must be dominated "
+    "by an abort/cancel is_set() check in the same function scope — a "
+    "stage that skips the check can write a buffer the caller already "
+    "reused after a timeout (the PR 4/PR 9 pre-mutation discipline, "
+    "generalized)",
+)
+def check_caller_buffer_ownership(ctx: FileContext) -> List[Finding]:
+    if ctx.relpath not in _KF703_MODULES or ctx.tree is None:
+        return []
+    out: List[Finding] = []
+    for fn in ctx.walk():
+        if not isinstance(fn, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        abort_refs = {
+            a.arg for a in fn.args.args + fn.args.kwonlyargs
+            if a.arg in _KF703_ABORT_NAMES
+        }
+        param_iters: Set[str] = set()
+        checks: List[int] = []
+        writes: List[Tuple[int, str]] = []
+        for node in _own_scope_stmts(fn):
+            if isinstance(node, ast.Name) and node.id in _KF703_ABORT_NAMES:
+                abort_refs.add(node.id)
+            if isinstance(node, ast.For):
+                iter_names = {
+                    n.attr for n in ast.walk(node.iter)
+                    if isinstance(n, ast.Attribute)
+                }
+                if "params" in iter_names:
+                    for t in ast.walk(node.target):
+                        if isinstance(t, ast.Name):
+                            param_iters.add(t.id)
+            if (
+                isinstance(node, ast.Call)
+                and isinstance(node.func, ast.Attribute)
+                and node.func.attr == "is_set"
+                and _last_segment(node.func.value) in _KF703_ABORT_NAMES
+            ):
+                checks.append(node.lineno)
+        for node in _own_scope_stmts(fn):
+            label = _caller_buffer_write(node, param_iters)
+            if label is not None:
+                writes.append((node.lineno, label))
+        first_check = min(checks) if checks else None
+        for lineno, label in sorted(writes):
+            # a detected is_set() call IS proof of an abort scope even
+            # when the event is held as an attribute (self._abort) the
+            # Name-based abort_refs scan cannot see
+            if not abort_refs and not checks:
+                out.append(Finding(
+                    "KF703", ctx.relpath, lineno,
+                    f"caller-owned buffer write {label} in a function "
+                    "with no abort/cancel in scope — thread the cancel "
+                    "event through and check it before mutating, or "
+                    "document the caller's guard with a suppression",
+                ))
+            elif first_check is None or lineno < first_check:
+                out.append(Finding(
+                    "KF703", ctx.relpath, lineno,
+                    f"caller-owned buffer write {label} precedes every "
+                    "abort/cancel is_set() check in this function — a "
+                    "cancelled walk must observe the abort BEFORE "
+                    "mutating buffers the caller may have reused",
                 ))
     return out

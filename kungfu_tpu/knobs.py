@@ -47,6 +47,13 @@ class Knob:
     kind: str = "str"  # human-readable type for the generated doc
     default_doc: str = ""  # display override when the default is dynamic
     strict: bool = False  # parse errors raise instead of warn-and-default
+    # cluster-agreed: the resolved value decides rendezvous names, message
+    # sizes or walk dataflow, so it MUST be identical fleet-wide and MUST
+    # appear in HostSession.engine_knobs()'s consensus tuple. This flag is
+    # the single source of truth for that contract — kfcheck rule KF701
+    # cross-checks it against the consensus tuple, so adding a
+    # cluster-agreed knob without consensus coverage is a build failure.
+    consensus: bool = False
 
 
 _REGISTRY: Dict[str, Knob] = {}
@@ -54,7 +61,7 @@ _SECTIONS: List[str] = []  # insertion order for doc rendering
 
 
 def _knob(name, default, parse, doc, *, section, kind, default_doc="",
-          strict=False) -> None:
+          strict=False, consensus=False) -> None:
     if name in _REGISTRY:
         raise ValueError(f"knob {name} declared twice")
     if section not in _SECTIONS:
@@ -62,6 +69,7 @@ def _knob(name, default, parse, doc, *, section, kind, default_doc="",
     _REGISTRY[name] = Knob(
         name=name, default=default, parse=parse, doc=doc, section=section,
         kind=kind, default_doc=default_doc, strict=strict,
+        consensus=consensus,
     )
 
 
@@ -289,7 +297,7 @@ _knob("KF_CONFIG_ALGO", "",
       "(topology heuristic). Unset: no override — the session keeps its "
       "configured strategy. Cluster-agreed: checked by "
       "`check_knob_consensus` at every session epoch.",
-      section=_SEC_ENGINE, kind="choice", strict=True,
+      section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
       default_doc="(unset: no override)")
 _knob("KF_CONFIG_WIRE", "",
       _choice("KF_CONFIG_WIRE", ("off", "bf16", "f16", "auto"),
@@ -297,20 +305,21 @@ _knob("KF_CONFIG_WIRE", "",
       "Compressed wire format for f32 allreduce payloads (bf16/f16 with "
       "f32 ring accumulation); `auto` resolves to bf16 for eligible "
       "payloads. Cluster-agreed.",
-      section=_SEC_ENGINE, kind="choice", strict=True, default_doc="off")
+      section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
+      default_doc="off")
 _knob("KF_CONFIG_WIRE_MIN_BYTES", str(64 << 10), _int,
       "Payloads below this bypass the wire codec (keeps probe-sized "
       "monitored traffic exact). Cluster-agreed.",
-      section=_SEC_ENGINE, kind="int")
+      section=_SEC_ENGINE, kind="int", consensus=True)
 _knob("KF_CONFIG_CHUNK_BYTES", "0", _int,
       "Overrides the chunked-walk chunk size heuristic (0 = heuristic). "
       "Cluster-agreed.",
-      section=_SEC_ENGINE, kind="int")
+      section=_SEC_ENGINE, kind="int", consensus=True)
 _knob("KF_CONFIG_SEGMENT_MIN_BYTES", str(64 << 10), _int,
       "Payloads below this fall back from the segmented ring to rank-0 "
       "tree graphs (per-segment framing overhead dominates). "
       "Cluster-agreed.",
-      section=_SEC_ENGINE, kind="int")
+      section=_SEC_ENGINE, kind="int", consensus=True)
 _knob("KF_CONFIG_GROUP_WINDOW", "", _opt_int,
       "Concurrent workspaces per batch in group collectives; default "
       "scales with the cgroup-aware core count (min(8, cores)). "
@@ -319,11 +328,11 @@ _knob("KF_CONFIG_GROUP_WINDOW", "", _opt_int,
 _knob("KF_CONFIG_GROUP_FUSE_MIN", "4", _int,
       "Minimum same-(dtype,op) tensors before group ops fuse them into "
       "one contiguous walk. Cluster-agreed.",
-      section=_SEC_ENGINE, kind="int")
+      section=_SEC_ENGINE, kind="int", consensus=True)
 _knob("KF_CONFIG_GROUP_BUCKET_BYTES", str(64 << 20), _int,
       "Fused-bucket size cap for the 3-stage pack/walk/unpack pipeline. "
       "Cluster-agreed (part of the fused workspace name).",
-      section=_SEC_ENGINE, kind="int")
+      section=_SEC_ENGINE, kind="int", consensus=True)
 _knob("KF_CONFIG_ASYNC", "",
       _choice("KF_CONFIG_ASYNC", ("off", "on", "auto"), empty_as="off"),
       "Asynchronous collective scheduler: group allreduces submitted "
@@ -332,7 +341,8 @@ _knob("KF_CONFIG_ASYNC", "",
       "≥2 peers (`auto`). `off` runs the synchronous step-end group op. "
       "Cluster-agreed: the mode decides the fused rendezvous names, so "
       "it is checked by `check_knob_consensus` at every session epoch.",
-      section=_SEC_ENGINE, kind="choice", strict=True, default_doc="off")
+      section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
+      default_doc="off")
 _knob("KF_CONFIG_ZERO", "",
       _choice("KF_CONFIG_ZERO", ("off", "on", "auto"), empty_as="off"),
       "ZeRO-1 sharded weight update: gradients are reduce-scattered, "
@@ -344,7 +354,8 @@ _knob("KF_CONFIG_ZERO", "",
       "update. Cluster-agreed: the mode decides the whole step's "
       "rendezvous dataflow, so it is checked by `check_knob_consensus` "
       "at every session epoch.",
-      section=_SEC_ENGINE, kind="choice", strict=True, default_doc="off")
+      section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
+      default_doc="off")
 _knob("KF_CONFIG_ASYNC_QUEUE", "2", _int,
       "Async scheduler launch-queue depth: how many packed buckets may "
       "sit between the pack and walk stages (bounds live pooled staging "
@@ -379,6 +390,22 @@ _knob("KF_DEBUG_LOCKS_HELD_MS", "1000", _float,
       "Lock hold time (ms) past which the detector reports a long-held "
       "lock.",
       section=_SEC_DEBUG, kind="float")
+_knob("KF_DEBUG_PROTOCOL", "", _bool,
+      "Truthy installs the runtime collective-order sentinel "
+      "(`devtools/protowatch.py`): wraps the session's collective entry "
+      "points, keeps a per-peer rolling digest of (kind, name, dtype, "
+      "nbytes, strategy) per round, cross-checks it on the "
+      "knob-independent star walk at scheduler flush boundaries, and on "
+      "divergence reports each peer's first divergent call site as "
+      "`protocol_divergence` audit events + "
+      "`kungfu_debug_protocol_*` metrics — before the rendezvous hang, "
+      "not after. Off = protowatch never imported, hot path untouched.",
+      section=_SEC_DEBUG, kind="bool")
+_knob("KF_DEBUG_PROTOCOL_WINDOW", "512", _int,
+      "Collective-order sentinel: max recorded entries per check window. "
+      "Past the cap, entries fold into the rolling digest (divergence is "
+      "still detected, but the per-entry diff loses the folded prefix).",
+      section=_SEC_DEBUG, kind="int")
 
 
 # --- accessors ---------------------------------------------------------
@@ -449,6 +476,12 @@ except knobs marked **strict**, which fail fast (they are cluster-agreed
 [docs/collectives.md](collectives.md) for the consensus check).
 
 Boolean knobs accept any truthy spelling (`1/true/yes/on/y/enabled`).
+
+Knobs marked **consensus** are cluster-agreed: their resolved value
+decides rendezvous names, message sizes or walk dataflow, so they ride
+`HostSession.engine_knobs()`'s fail-fast consensus check at every
+session epoch — kfcheck rule KF701 enforces that the registry flag and
+the consensus tuple never drift apart.
 """
 
 
@@ -461,7 +494,9 @@ def render_doc() -> str:
         for k in sorted((k for k in _REGISTRY.values()
                          if k.section == section), key=lambda k: k.name):
             default = k.default_doc or k.default or "(empty)"
-            kind = k.kind + (" · strict" if k.strict else "")
+            kind = k.kind + (" · strict" if k.strict else "") + (
+                " · consensus" if k.consensus else ""
+            )
             out.append(f"| `{k.name}` | {kind} | `{default}` | {k.doc} |")
     out.append("")
     return "\n".join(out)

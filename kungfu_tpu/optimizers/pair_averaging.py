@@ -149,7 +149,11 @@ class PairAveraging:
         self._build(params)
         self.peer.p2p.save_version(self._version, self.blob, _pack_host(params))
         if not self.peer.config.single_process:
-            self.peer.current_session().barrier(tag=":pair-avg-init")
+            # KF700: version-stamped so a re-init after an elastic
+            # resize can never rendezvous with the old epoch's barrier
+            self.peer.current_session().barrier(
+                tag=f":pair-avg-init:v{self.peer.cluster_version}"
+            )
         self._start_prefetch()
         return self.base.init(params)
 

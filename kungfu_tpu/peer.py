@@ -82,6 +82,12 @@ class Peer:
         # (update = reconnect + new-session barrier, i.e. joiner-bounded).
         # Parity: the reference's ResizeProfiler phase breakdown.
         self.last_resize_phases: dict = {}
+        # KF700: config-poll/reload consensus rounds consumed, PER cluster
+        # version — every member of a session epoch runs these consensus
+        # rounds in lockstep (an allreduce needs all of them), so the
+        # (version, rounds-this-version) pair agrees cluster-wide where a
+        # process-lifetime counter would diverge for joiners
+        self._cfg_consensus_seq: dict = {}
 
         self.store = BlobStore()
         self.client = Client(self.self_id, use_unix=not config.single_process)
@@ -353,12 +359,32 @@ class Peer:
         degrades to a no-op instead of hanging the training loop."""
         sess = self.current_session()
         current = Cluster(runners=self.config.runners, workers=self._peers)
+        # KF700: the poll retries back-to-back consensus rounds, so each
+        # round gets its own rendezvous name — a slow peer's round r must
+        # never consume the lanes of a fast peer's round r+1. Peers
+        # iterate in lockstep (bytes_consensus resolves identically
+        # cluster-wide), and the per-epoch sequence survives REPEATED
+        # calls at the same version (a plain per-call attempt counter
+        # would reuse names across calls)
         while True:
             cluster = self._get_config(url) or current
             with stall_detect(f"wait_new_config({url})"):
-                if sess.bytes_consensus(cluster.to_bytes(), ":cfg"):
+                if sess.bytes_consensus(
+                    cluster.to_bytes(), self._cfg_consensus_name("cfg")
+                ):
                     return cluster
             time.sleep(0.2)
+
+    def _cfg_consensus_name(self, kind: str) -> str:
+        """Round-stamped rendezvous name for the config-plane consensus
+        lanes: `:{kind}:v{version}:{seq}` with seq the count of such
+        rounds THIS session epoch has run (all epoch members run them in
+        lockstep, so the stamp agrees cluster-wide; a joiner starts the
+        new epoch at 0 together with everyone else)."""
+        v = self.cluster_version
+        seq = self._cfg_consensus_seq.get(v, 0)
+        self._cfg_consensus_seq[v] = seq + 1
+        return f":{kind}:v{v}:{seq}"
 
     def resize_cluster_from_url(self) -> Tuple[bool, bool]:
         """(changed, detached). Parity: ResizeClusterFromURL (peer.go:265)."""
@@ -413,7 +439,12 @@ class Peer:
         if cluster.workers == self._peers:
             return False, False
         sess = self.current_session()
-        if not sess.bytes_consensus(cluster.to_bytes(), ":reload"):
+        # KF700: epoch-sequenced — a reload agreement must not rendezvous
+        # with an earlier attempt's lanes (repeat change_cluster calls at
+        # one version) nor with another epoch's
+        if not sess.bytes_consensus(
+            cluster.to_bytes(), self._cfg_consensus_name("reload")
+        ):
             return False, False
         stage = {
             "Version": self.cluster_version + 1,

@@ -1,0 +1,105 @@
+"""Protowatch e2e worker (ISSUE 12): drives real collectives under
+``KF_DEBUG_PROTOCOL=1`` on an np>=2 kfrun cluster.
+
+Two modes, selected by ``PROTOWATCH_INJECT``:
+
+- unset: several rounds of sync collectives (allreduce, group, barrier,
+  consensus) with an explicit boundary check per round, then two async
+  scheduler rounds (whose flushes auto-check) — everything must come
+  back agreed, zero divergences (the sentinel must not cry wolf on a
+  healthy workload).
+- ``1``: rank 0 submits an EXTRA tensor into the async scheduler's
+  registration round. The registration consensus detects the divergence
+  (every peer raises the named RuntimeError instead of hanging), and
+  protowatch's paired boundary check must have named the exact tensor
+  and the submitting call site on EVERY peer — the ``protocol_divergence``
+  audit record this agent prints as ``INJECT-REPORT``.
+"""
+
+import os
+import sys
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.base.ops import ReduceOp
+from kungfu_tpu.base.workspace import Workspace
+from kungfu_tpu.devtools import protowatch
+from kungfu_tpu.telemetry import audit
+
+
+def clean_run(sess, rank: int, size: int) -> None:
+    expected = size * (size + 1) / 2
+    for rnd in range(3):
+        out = api.all_reduce_array(
+            np.full(512, rank + 1, np.float32), name=f"pw:{rnd}"
+        )
+        assert np.all(out == expected), out[:4]
+        api.run_barrier()
+        assert api.consensus(b"agreed", f"pw-c:{rnd}")
+        assert protowatch.check(sess), "healthy round flagged divergent"
+    # async scheduler rounds: submits record, flush auto-checks
+    sched = sess.scheduler()
+    bufs = [np.full(256, float(rank + 1), np.float32) for _ in range(2)]
+    outs = [np.zeros(256, np.float32) for _ in range(2)]
+    for rnd in range(2):
+        for i, (b, o) in enumerate(zip(bufs, outs)):
+            b[:] = rank + 1
+            sched.submit(Workspace(send=b, recv=o, op=ReduceOp.SUM,
+                                   name=f"pw-async:{i}"))
+        sched.flush()
+        for o in outs:
+            assert np.all(o == expected), o[:4]
+    st = protowatch.stats(sess)
+    assert st["checks"] >= 5, st
+    assert st["divergences"] == 0, st
+    print(f"CLEAN-OK rank={rank} checks={st['checks']}")
+
+
+def inject_run(sess, rank: int, size: int) -> None:
+    sched = sess.scheduler()
+    bufs = [np.full(128, float(rank + 1), np.float32) for _ in range(2)]
+    outs = [np.zeros(128, np.float32) for _ in range(2)]
+    for i, (b, o) in enumerate(zip(bufs, outs)):
+        sched.submit(Workspace(send=b, recv=o, op=ReduceOp.SUM,
+                               name=f"pw-async:{i}"))
+    if rank == 0:
+        extra = np.ones(64, np.float32)
+        sched.submit(Workspace(send=extra, recv=np.zeros(64, np.float32),
+                               op=ReduceOp.SUM, name="pw-extra-tensor"))
+    try:
+        sched.flush()
+    except RuntimeError as e:
+        assert "diverged" in str(e), e
+        print(f"INJECT-RAISED rank={rank}: {e}")
+    else:
+        raise AssertionError("divergent registration round did not raise")
+    recs = audit.records(kind="protocol_divergence")
+    assert recs, "no protocol_divergence audit event on this peer"
+    d = recs[0].detail
+    assert "pw-extra-tensor" in (str(d.get("mine")) + str(d.get("theirs"))), d
+    site = d.get("mine") if rank == 0 else d.get("theirs")
+    assert "protowatch_agent.py" in str(site), d
+    print(f"INJECT-REPORT rank={rank} round={d.get('round')} "
+          f"mine={d.get('mine')} theirs={d.get('theirs')}")
+
+
+def main() -> int:
+    from kungfu_tpu.peer import get_default_peer
+
+    rank = api.current_rank()
+    size = api.cluster_size()
+    sess = get_default_peer().current_session()
+    assert getattr(sess, "_protowatch", None) is not None, (
+        "KF_DEBUG_PROTOCOL=1 did not attach protowatch"
+    )
+    if os.environ.get("PROTOWATCH_INJECT"):
+        inject_run(sess, rank, size)
+    else:
+        clean_run(sess, rank, size)
+    api.run_barrier()
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

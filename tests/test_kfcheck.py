@@ -533,3 +533,381 @@ def test_cli_json_and_exit_codes(tmp_path):
         capture_output=True, text=True, env=env, cwd=REPO, timeout=120,
     )
     assert r.returncode == 2
+
+
+# ---------------------------------------------------------------------
+# KF7xx — distributed protocol (ISSUE 12)
+# ---------------------------------------------------------------------
+
+
+def test_kf700_bare_wire_names_flagged():
+    p = project_of(("kungfu_tpu/x.py", '''
+        def f(sess, data):
+            w = Workspace(send=a, recv=b, op=op, name="kungfu::static")
+            sess.barrier(tag=":one-shot")
+            sess.bytes_consensus(data, ":cfg")
+            sess.broadcast_bytes(data, "blob")
+            sess.all_gather_shards(full, "weights")
+    '''))
+    out = R.check_wire_names(p)
+    assert rule_ids(out) == ["KF700"] * 5
+    assert "kungfu::static" in out[0].message
+
+
+def test_kf700_stamped_and_derived_names_pass():
+    p = project_of(("kungfu_tpu/x.py", '''
+        def f(sess, data, rnd, name):
+            w = Workspace(send=a, recv=b, op=op, name=f"kungfu::x:r{rnd}")
+            w2 = Workspace(a, b, op, name)               # runtime-derived
+            w3 = Workspace(a, b, op, w.name + ":bcast")  # derived suffix
+            sess.barrier(tag=f":v{sess.version}")
+            sess.bytes_consensus(data, f":cfg:{rnd}")
+            sess.barrier()                               # engine stamps it
+    '''))
+    assert R.check_wire_names(p) == []
+
+
+def test_kf700_resolves_module_constants_and_const_folds():
+    p = project_of(
+        ("kungfu_tpu/names.py", 'TAG = ":static-tag"\n'),
+        ("kungfu_tpu/x.py", '''
+            from kungfu_tpu.names import TAG
+            def f(sess, data):
+                sess.bytes_consensus(data, TAG)          # resolves: finding
+                sess.bytes_consensus(data, ":a" + ":b")  # const fold: finding
+        '''),
+    )
+    out = R.check_wire_names(p)
+    assert rule_ids(out) == ["KF700", "KF700"]
+    assert ":static-tag" in out[0].message
+    assert ":a:b" in out[1].message
+
+
+def test_kf700_justified_suppression(tmp_path):
+    out = run_tmp_project(tmp_path, {"x.py": '''
+        def f(sess):
+            # kfcheck: disable=KF700 — one-shot bootstrap name, the
+            # session epoch fences it from any earlier run
+            sess.bytes_consensus(b"x", ":bootstrap")
+    '''}, select=["KF700"])
+    assert out == []
+
+
+_KF701_REGISTRY_OK = '''
+    def _knob(*a, **kw): pass
+    _knob("KF_CONFIG_A", "", str, "a", consensus=True)
+    _knob("KF_CONFIG_B", "0", int, "b", consensus=True)
+    _knob("KF_LOCAL_ONLY", "0", int, "c")
+'''
+
+_KF701_SESSION_OK = '''
+    class HostSession:
+        def engine_knobs(self):
+            return [
+                ("KF_CONFIG_A", knobs.get("KF_CONFIG_A")),
+                ("KF_CONFIG_B", str(self.B)),
+            ]
+'''
+
+
+def test_kf701_clean_pair_passes():
+    p = project_of(
+        ("kungfu_tpu/knobs.py", _KF701_REGISTRY_OK),
+        ("kungfu_tpu/collective/host_session.py", _KF701_SESSION_OK),
+    )
+    assert R.check_consensus_coverage(p) == []
+
+
+def test_kf701_consensus_knob_missing_from_tuple_is_drift():
+    # the acceptance fixture: add a strict walk-affecting knob with
+    # consensus=True but forget engine_knobs() — must be a finding
+    registry = _KF701_REGISTRY_OK + (
+        '    _knob("KF_CONFIG_NEW_LAYOUT", "0", int, "d", consensus=True)\n'
+    )
+    p = project_of(
+        ("kungfu_tpu/knobs.py", registry),
+        ("kungfu_tpu/collective/host_session.py", _KF701_SESSION_OK),
+    )
+    out = R.check_consensus_coverage(p)
+    assert rule_ids(out) == ["KF701"]
+    assert "KF_CONFIG_NEW_LAYOUT" in out[0].message
+    assert out[0].path == "kungfu_tpu/knobs.py"
+
+
+def test_kf701_tuple_entry_not_flagged_in_registry_is_drift():
+    session = _KF701_SESSION_OK.replace(
+        '("KF_CONFIG_B", str(self.B)),',
+        '("KF_CONFIG_B", str(self.B)),\n'
+        '                ("KF_LOCAL_ONLY", str(self.C)),',
+    )
+    p = project_of(
+        ("kungfu_tpu/knobs.py", _KF701_REGISTRY_OK),
+        ("kungfu_tpu/collective/host_session.py", session),
+    )
+    out = R.check_consensus_coverage(p)
+    assert rule_ids(out) == ["KF701"]
+    assert "KF_LOCAL_ONLY" in out[0].message
+    assert out[0].path == "kungfu_tpu/collective/host_session.py"
+
+
+def test_kf701_broken_tuple_scan_self_reports():
+    p = project_of(
+        ("kungfu_tpu/knobs.py", _KF701_REGISTRY_OK),
+        ("kungfu_tpu/collective/host_session.py",
+         "class HostSession:\n    pass\n"),
+    )
+    out = R.check_consensus_coverage(p)
+    assert rule_ids(out) == ["KF701"]
+    assert "scan looks broken" in out[0].message
+
+
+def test_kf701_live_registry_consensus_pair_agrees():
+    """The acceptance criterion's other half: the REAL registry and the
+    REAL engine_knobs() tuple must pass the rule today."""
+    core._ensure_rules_loaded()
+    assert core.run_project(select=["KF701"], use_cache=False) == []
+    from kungfu_tpu import knobs
+
+    marked = {k.name for k in knobs.declared().values() if k.consensus}
+    assert "KF_CONFIG_ZERO" in marked and "KF_CONFIG_ASYNC" in marked
+    assert "KF_CONFIG_ASYNC_QUEUE" not in marked  # local-only by design
+
+
+def test_kf702_rank_guarded_collective_without_counterpart():
+    out = run_rule(R.check_collective_symmetry, '''
+        def f(self, w):
+            if self.rank == 0:
+                self.sess.all_reduce(w)      # finding: no counterpart
+            if rank != root:
+                pass
+            else:
+                sess.barrier()               # finding: no counterpart
+    ''')
+    assert rule_ids(out) == ["KF702", "KF702"]
+    assert "all_reduce" in out[0].message
+
+
+def test_kf702_symmetric_and_unguarded_calls_pass():
+    out = run_rule(R.check_collective_symmetry, '''
+        def f(self, w, blob):
+            if self.rank == 0:
+                self.sess.broadcast_bytes(blob, f"n:{v}")
+            else:
+                self.sess.broadcast_bytes(b"", f"n:{v}")
+            self.sess.all_reduce(w)          # unguarded: fine
+            if mode == "fast":               # not a rank test
+                self.sess.barrier()
+            if self.rank == 0:
+                log.info("root here")        # no collectives at all
+    ''')
+    assert out == []
+
+
+def test_kf702_point_to_point_out_of_scope():
+    # rooted send/recv asymmetry is how rooted walks are BUILT — the
+    # rule only polices the rendezvous entry points
+    out = run_rule(R.check_collective_symmetry, '''
+        def gather(self, w, root):
+            if self.rank != root:
+                self.client.send(self.peers[root], w.name, buf(w.send))
+                return
+    ''')
+    assert out == []
+
+
+_WALKS = "kungfu_tpu/collective/walks.py"
+
+
+def test_kf703_write_without_abort_scope():
+    out = run_rule(R.check_caller_buffer_ownership, '''
+        def unpack(self, item):
+            np.copyto(w.recv, fused.recv)
+    ''', _WALKS)
+    assert rule_ids(out) == ["KF703"]
+    assert "no abort/cancel in scope" in out[0].message
+
+
+def test_kf703_write_before_check_flagged_after_check_passes():
+    out = run_rule(R.check_caller_buffer_ownership, '''
+        def walk(self, w, cancel):
+            decode_wire(w.recv, enc, wire)       # finding: precedes check
+            if cancel.is_set():
+                raise TimeoutError(w.name)
+            np.copyto(w.recv, incoming)          # dominated: fine
+    ''', _WALKS)
+    assert rule_ids(out) == ["KF703"]
+    assert out[0].line == 3
+
+
+def test_kf703_params_loop_and_acc_alias_recognized():
+    out = run_rule(R.check_caller_buffer_ownership, '''
+        def scatter(self, b):
+            for j, p in enumerate(b.params):
+                np.copyto(p, b.W[j])             # finding: param views
+        def seg(self, acc):
+            reduce_segment(acc, rb, re_, incoming, op)   # finding: acc
+    ''', "kungfu_tpu/collective/zero.py")
+    assert rule_ids(out) == ["KF703", "KF703"]
+
+
+def test_kf703_nested_function_scopes_are_independent():
+    # the nested fn's check must NOT satisfy the outer scope (and vice
+    # versa): each closure runs under its own abort discipline
+    out = run_rule(R.check_caller_buffer_ownership, '''
+        def walk(self, w, cancel):
+            def recv_one():
+                if cancel.is_set():
+                    raise TimeoutError(w.name)
+                np.copyto(w.recv, incoming)      # fine: dominated here
+            decode_wire(w.recv, enc, wire)       # finding: outer unchecked
+    ''', _WALKS)
+    assert rule_ids(out) == ["KF703"]
+    assert out[0].line == 7
+
+
+def test_kf703_only_applies_to_walk_engine_modules():
+    src = '''
+        def f(w):
+            np.copyto(w.recv, data)
+    '''
+    assert run_rule(R.check_caller_buffer_ownership, src) == []
+    assert rule_ids(run_rule(
+        R.check_caller_buffer_ownership, src,
+        "kungfu_tpu/collective/pipeline.py")) == ["KF703"]
+
+
+# ---------------------------------------------------------------------
+# the per-file result cache (ISSUE 12 satellite)
+# ---------------------------------------------------------------------
+
+
+def write_pkg(tmp_path, files):
+    pkg = tmp_path / "kungfu_tpu"
+    pkg.mkdir(exist_ok=True)
+    for rel, src in files.items():
+        p = pkg / rel
+        p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(textwrap.dedent(src))
+    return pkg
+
+
+def run_cached(tmp_path, use_cache=True, select=None):
+    core._ensure_rules_loaded()
+    return core.run_project(
+        pkg_root=str(tmp_path / "kungfu_tpu"), repo_root=str(tmp_path),
+        select=select, use_cache=use_cache,
+    )
+
+
+def test_cache_round_trip_preserves_findings(tmp_path):
+    src = {"x.py": "def f(ev):\n    ev.wait()\n"}
+    write_pkg(tmp_path, src)
+    first = run_cached(tmp_path)
+    assert (tmp_path / ".kfcheck-cache.json").exists()
+    second = run_cached(tmp_path)  # served from cache
+    assert first == second
+    assert any(f.rule == "KF301" for f in second)
+    # and the cached run really did skip parsing: the context comes back
+    # from facts with the tree unparsed
+    cache = core.ResultCache(str(tmp_path))
+    files = core.load_files(str(tmp_path / "kungfu_tpu"), str(tmp_path), cache)
+    assert files[0].from_cache
+    assert files[0]._tree is core._UNPARSED
+
+
+def test_cache_invalidated_by_content_change(tmp_path):
+    write_pkg(tmp_path, {"x.py": "def f(ev):\n    ev.wait()\n"})
+    assert any(f.rule == "KF301" for f in run_cached(tmp_path))
+    write_pkg(tmp_path, {"x.py": "def f(ev):\n    ev.wait(1.0)\n"})
+    # stale entry must not resurrect the fixed finding (full runs on a
+    # bare tmp tree also emit KF102/KF600 doc self-checks — not ours)
+    assert [f for f in run_cached(tmp_path) if f.rule == "KF301"] == []
+
+
+def test_cache_invalidated_by_ruleset_version(tmp_path, monkeypatch):
+    write_pkg(tmp_path, {"x.py": "def f(ev):\n    ev.wait()\n"})
+    run_cached(tmp_path)
+    cache_file = tmp_path / ".kfcheck-cache.json"
+    import json as _json
+
+    data = _json.loads(cache_file.read_text())
+    assert data["version"] == core.ruleset_version()
+    # a rule edit changes the version: every entry must be recomputed
+    monkeypatch.setattr(core, "_ruleset_version_memo", "different-rules")
+    cache = core.ResultCache(str(tmp_path))
+    assert cache.files == {}  # versioned out wholesale
+
+
+def test_cache_not_written_by_select_runs(tmp_path):
+    write_pkg(tmp_path, {"x.py": "def f(ev):\n    ev.wait()\n"})
+    run_cached(tmp_path, select=["KF301"])
+    assert not (tmp_path / ".kfcheck-cache.json").exists()
+    run_cached(tmp_path, use_cache=False)
+    assert not (tmp_path / ".kfcheck-cache.json").exists()
+
+
+def test_cache_prunes_deleted_files(tmp_path):
+    write_pkg(tmp_path, {"x.py": "A = 1\n", "y.py": "B = 2\n"})
+    run_cached(tmp_path)
+    (tmp_path / "kungfu_tpu" / "y.py").unlink()
+    run_cached(tmp_path)
+    import json as _json
+
+    data = _json.loads((tmp_path / ".kfcheck-cache.json").read_text())
+    assert set(data["files"]) == {"kungfu_tpu/x.py"}
+
+
+def test_cached_suppressions_still_apply_and_rot(tmp_path):
+    ours = ("KF001", "KF003", "KF301")
+
+    def mine(findings):
+        return [f.rule for f in findings if f.rule in ours]
+
+    write_pkg(tmp_path, {"x.py": '''
+        def f(ev):
+            ev.wait()  # kfcheck: disable=KF301 — abort-aware by contract
+    '''})
+    assert mine(run_cached(tmp_path)) == []
+    assert mine(run_cached(tmp_path)) == []  # cached: still suppressed
+    # stale suppressions keep being findings from cached facts too
+    write_pkg(tmp_path, {"x.py": '''
+        def f(ev):
+            ev.wait(1.0)  # kfcheck: disable=KF301 — nothing to suppress
+    '''})
+    run_cached(tmp_path)
+    assert mine(run_cached(tmp_path)) == ["KF003"]
+
+
+# ---------------------------------------------------------------------
+# the unified devtools gate (ISSUE 12 satellite)
+# ---------------------------------------------------------------------
+
+
+def test_unified_check_entry_point_clean_tree():
+    """`python -m kungfu_tpu.devtools.check` is THE devtools gate: one
+    invocation covering kfcheck + knobs-doc byte-compare + metric-doc
+    lint, exit 0 on the clean tree."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-m", "kungfu_tpu.devtools.check"],
+        capture_output=True, text=True, env=env, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    for section in ("[kfcheck] clean", "[knobs-doc] clean",
+                    "[metric-docs] clean", "check: clean"):
+        assert section in r.stdout, r.stdout
+
+
+def test_kf703_attribute_held_abort_event_counts_as_scope():
+    # the abort event may live on self (self._abort.is_set()): the
+    # detected check IS proof of an abort scope even though the
+    # Name-based reference scan cannot see the attribute
+    out = run_rule(R.check_caller_buffer_ownership, '''
+        def unpack(self, item):
+            if self._abort.is_set():
+                return
+            np.copyto(w.recv, fused.recv)
+    ''', _WALKS)
+    assert out == []
