@@ -427,6 +427,65 @@ def bench_host_zero_ab(model: str, iters: int) -> None:
     )
 
 
+def report_steps(model: str) -> None:
+    """The --steps report (ISSUE 13): per-step critical-path summary
+    from the step plane itself — overlap measured per recorded timeline
+    (replacing the scheduler-side flush-wait proxy as the headline
+    number; both print so drift between the two planes is visible), the
+    submit→launch queue-delay fraction, and the bucket that was the
+    long pole most often with its attributed edge. Rank 0 only; reads
+    this worker's own /steptrace ring (the bench has no aggregator, so
+    the election is over local lanes)."""
+    from kungfu_tpu import api
+    from kungfu_tpu.telemetry import steptrace
+
+    if api.current_rank() != 0:
+        return
+    tls = steptrace.get_store().timelines()
+    done = [t for t in tls if t.get("busy_us")]
+    if not done:
+        log.echo(
+            f"STEPS {model}: no recorded step timelines (the step plane "
+            "records scheduler rounds; needs KF_CONFIG_ASYNC=on|auto and "
+            "KF_TELEMETRY_SPAN_SAMPLE > 0)"
+        )
+        return
+    ov = [t["overlap_frac"] for t in done if t.get("overlap_frac") is not None]
+    qd = [
+        t["queue_delay_frac"] for t in done
+        if t.get("queue_delay_frac") is not None
+    ]
+    busy_ms = sum(t["busy_us"] for t in done) / len(done) / 1e3
+    flush_ms = sum(t.get("flush_wait_us") or 0 for t in done) / len(done) / 1e3
+    log.echo(
+        f"STEPS {model}: {len(done)} recorded steps, overlap "
+        f"{sum(ov) / len(ov):.0%} (step plane)"
+        + (f", queue delay {sum(qd) / len(qd):.1%}" if qd else "")
+        + f", engine {busy_ms:.1f} ms vs flush-wait {flush_ms:.1f} ms per step"
+    )
+    # most-frequent critical bucket across the recorded steps, elected
+    # with the cluster merge's own math over this worker's lanes
+    wins: dict = {}
+    for t in done:
+        elected = steptrace.critical_path({"self": t})
+        c = elected.get("critical")
+        if not c:
+            continue
+        key = (c.get("bucket"), c.get("name"), c.get("edge"))
+        agg = wins.setdefault(key, {"n": 0, "self_us": 0.0})
+        agg["n"] += 1
+        agg["self_us"] += c["self_us"]
+    for (bucket, name, edge), agg in sorted(
+        wins.items(), key=lambda kv: -kv[1]["n"]
+    )[:3]:
+        log.echo(
+            f"STEPS critical: bucket {bucket} {name} in "
+            f"{agg['n']}/{len(done)} steps, self "
+            f"{agg['self_us'] / agg['n'] / 1e3:.1f} ms/step"
+            + (f", edge →{edge}" if edge else "")
+        )
+
+
 def bench_host(model: str, iters: int, warmup: int = 4) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
@@ -631,6 +690,14 @@ def main() -> None:
         "the OVERLAP line",
     )
     p.add_argument(
+        "--steps", action="store_true", dest="steps_report",
+        help="HOST only: after the bench, print the STEPS report — "
+        "per-step overlap/queue-delay fractions and the most-frequent "
+        "critical bucket from the step plane's recorded timelines "
+        "(meaningful with --async/--zero, whose legs drive the "
+        "scheduler the plane instruments)",
+    )
+    p.add_argument(
         "--async", action="store_true", dest="async_ab",
         help="HOST only: paired same-process async-scheduler A/B — "
         "alternate the serial step loop (compute all, then one step-end "
@@ -642,12 +709,12 @@ def main() -> None:
     args = p.parse_args()
     if args.method != "HOST" and (
         args.algo or args.wire or args.wire_ab or args.async_ab
-        or args.zero_ab
+        or args.zero_ab or args.steps_report
     ):
         # the default method is XLA: silently measuring the wrong plane
         # is worse than an error
-        p.error("--algo/--wire/--wire-ab/--async/--zero only apply to "
-                "--method HOST")
+        p.error("--algo/--wire/--wire-ab/--async/--zero/--steps only "
+                "apply to --method HOST")
     if sum(1 for f in (args.wire_ab, args.async_ab, args.zero_ab) if f) > 1:
         p.error("--wire-ab/--async/--zero are separate A/Bs — pick one")
     if args.method == "HOST":
@@ -681,6 +748,8 @@ def main() -> None:
         bench_host_zero_ab(args.model, args.iters)
     else:
         bench_host(args.model, args.iters)
+    if args.method == "HOST" and args.steps_report:
+        report_steps(args.model)
 
 
 if __name__ == "__main__":

@@ -124,11 +124,16 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         client: Client,
         endpoint: CollectiveEndpoint,
         timeout: float = DEFAULT_TIMEOUT,
+        cluster_version: int = 0,
     ):
         rank = peers.rank(self_id)
         if rank is None:
             raise ValueError(f"{self_id} not in peer list {peers}")
         self.self_id = self_id
+        # the elastic cluster version this epoch serves (peer.py passes
+        # it; 0 for bare sessions) — the step plane's session_epoch
+        # stamp, identical on every peer of the epoch by construction
+        self.cluster_version = int(cluster_version)
         self.peers = peers
         self.rank = rank
         self.local_rank = peers.local_rank(self_id)

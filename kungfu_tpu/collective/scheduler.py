@@ -83,6 +83,7 @@ from kungfu_tpu import knobs
 from kungfu_tpu.base.workspace import Workspace
 from kungfu_tpu.telemetry import config as tconfig
 from kungfu_tpu.telemetry import metrics as tmetrics
+from kungfu_tpu.telemetry import steptrace
 from kungfu_tpu.utils import trace
 from kungfu_tpu.utils.handoff import HandoffQueue
 from kungfu_tpu.utils.stall import stall_detect
@@ -137,6 +138,17 @@ class CollectiveScheduler:
     def __init__(self, sess):
         self.sess = sess
         self.queue_depth = max(1, int(knobs.get("KF_CONFIG_ASYNC_QUEUE")))
+        # step plane (ISSUE 13): the session epoch every timeline and
+        # step-stamped span carries — the CLUSTER version, identical on
+        # every peer of the epoch, so the aggregator can group timelines
+        # cross-peer (a local session counter would diverge for joiners)
+        self.epoch_id = int(getattr(sess, "cluster_version", 0))
+        # current round's step recorder (None: sampled out / round 0 /
+        # plane off); per-unit metadata derived from the plan so lanes
+        # can be labelled without touching workspaces off-thread
+        self._steprec: Optional[steptrace.StepRecorder] = None
+        self._key_unit: Dict[_Key, int] = {}
+        self._unit_meta: Dict[int, Tuple[str, str, int, int]] = {}
         self._cond = threading.Condition()
         self._abort = threading.Event()
         self._errors: List[BaseException] = []
@@ -254,6 +266,22 @@ class CollectiveScheduler:
                 )
             self._submitted.add(key)
             self._pending[key] = w
+            # step plane: the round's recorder begins at its FIRST
+            # submission (subject to KF_TELEMETRY_SPAN_SAMPLE — a
+            # sampled-out round allocates nothing and every note below
+            # is a no-op via the None guard)
+            if len(self._submitted) == 1 and self._plan:
+                self._steprec = steptrace.get_store().begin_step(
+                    self.epoch_id, self._round
+                )
+            rec = self._steprec
+            if rec is not None:
+                ui = self._key_unit.get(key)
+                if ui is not None:
+                    kind, label, nbytes, nmem = self._unit_meta[ui]
+                    rec.bucket(ui, kind, label, nbytes, nmem).note_submit(
+                        time.perf_counter() * 1e6
+                    )
             self._cond.notify_all()
 
     def flush(self, timeout: Optional[float] = None) -> None:
@@ -332,6 +360,12 @@ class CollectiveScheduler:
                 self._stat["flush_wait_s"] += wait
                 self._stat["busy_s"] += busy
                 self._stat["overlap_s"] += max(0.0, busy - wait)
+                # seal the step timeline (the ring holds the recorder,
+                # so a ZeRO gather tail landing after this still writes
+                # its lane — rendered at export time)
+                rec, self._steprec = self._steprec, None
+                if rec is not None:
+                    rec.finish(flush_wait_s=wait, busy_s=busy)
                 self._cond.notify_all()
         if self._flush_wait_ctr is not None:
             self._flush_wait_ctr.inc(wait)
@@ -478,6 +512,20 @@ class CollectiveScheduler:
             )
         plan = self._build_plan(registry)
         known = set(registry)
+        # step-plane lane metadata: pure function of the plan, computed
+        # once so the submit hot path only does dict lookups
+        key_unit: Dict[_Key, int] = {}
+        unit_meta: Dict[int, Tuple[str, str, int, int]] = {}
+        for u in plan:
+            label = u.keys[0][0]
+            if len(u.keys) > 1:
+                label += f"+{len(u.keys) - 1}"
+            nbytes = sum(
+                k[1] * np.dtype(k[2]).itemsize for k in u.keys
+            )
+            unit_meta[u.index] = (u.kind, label, nbytes, len(u.keys))
+            for k in u.keys:
+                key_unit[k] = u.index
         with self._cond:
             # validate EVERYTHING before committing any state: raising
             # after self._registry is set but before the threads start
@@ -510,6 +558,8 @@ class CollectiveScheduler:
             self._registry = registry
             self._known = known
             self._plan = plan
+            self._key_unit = key_unit
+            self._unit_meta = unit_meta
             self._pending.update(pending)
             self._submitted |= submitted
             self._first_round.clear()
@@ -624,7 +674,11 @@ class CollectiveScheduler:
                     if all(k in self._pending for k in unit.keys):
                         self._next_unit += 1
                         members = [self._pending.pop(k) for k in unit.keys]
-                        return unit, members, self._round
+                        # the recorder captured here travels WITH the
+                        # unit through the stage queues: a ZeRO gather
+                        # tail lands after flush advanced the round, and
+                        # must still write the round it belongs to
+                        return unit, members, self._round, self._steprec
                 self._cond.wait(0.2)
 
     def _launch_loop(self) -> None:
@@ -633,34 +687,44 @@ class CollectiveScheduler:
                 claimed = self._claim_next()
                 if claimed is None:
                     return
-                unit, members, rnd = claimed
+                unit, members, rnd, rec = claimed
+                lane = (
+                    rec.bucket(unit.index, *self._unit_meta[unit.index])
+                    if rec is not None else None
+                )
+                if lane is not None:
+                    lane.note_launch(time.perf_counter() * 1e6)
                 t0 = time.perf_counter()
-                if unit.kind == "zero":
-                    with trace.span("sched.pack", unit=unit.index):
-                        # the handler packs into its persistent bucket
-                        # staging and stamps its own round-qualified
-                        # wire names (:zrs:/:zag:)
-                        item = self._handler.pack(unit.zindex, members, rnd)
-                elif unit.fused:
-                    with trace.span("sched.pack", unit=unit.index):
-                        # round-stamped fused name: back-to-back rounds
-                        # must not collide on the wire (a fast peer's
-                        # round r+1 sends must never be consumed by a
-                        # slow peer still walking round r)
-                        item = self.sess._pack_bucket(
-                            unit.index, members, name_prefix=f"r{rnd}:"
+                with trace.step_scope(self.epoch_id, rnd):
+                    if unit.kind == "zero":
+                        with trace.span("sched.pack", unit=unit.index):
+                            # the handler packs into its persistent
+                            # bucket staging and stamps its own round-
+                            # qualified wire names (:zrs:/:zag:)
+                            item = self._handler.pack(
+                                unit.zindex, members, rnd
+                            )
+                    elif unit.fused:
+                        with trace.span("sched.pack", unit=unit.index):
+                            # round-stamped fused name: back-to-back
+                            # rounds must not collide on the wire (a
+                            # fast peer's round r+1 sends must never be
+                            # consumed by a slow peer still walking
+                            # round r)
+                            item = self.sess._pack_bucket(
+                                unit.index, members, name_prefix=f"r{rnd}:"
+                            )
+                    else:
+                        w = members[0]
+                        item = (
+                            Workspace(
+                                send=w.send, recv=w.recv, op=w.op,
+                                name=f"{w.name}::as:r{rnd}",
+                            ),
+                            None, None, members,
                         )
-                else:
-                    w = members[0]
-                    item = (
-                        Workspace(
-                            send=w.send, recv=w.recv, op=w.op,
-                            name=f"{w.name}::as:r{rnd}",
-                        ),
-                        None, None, members,
-                    )
                 self._add_busy(time.perf_counter() - t0, queued=+1)
-                if not self._walkq.put((unit, item)):
+                if not self._walkq.put((unit, lane, rnd, item)):
                     return  # aborted while the queue was full
         except BaseException as e:  # noqa: BLE001 - channeled to flush()
             self._record_error(e)
@@ -675,14 +739,19 @@ class CollectiveScheduler:
                     return
                 if self._abort.is_set():
                     continue  # drain to the sentinel
-                unit, item = got
+                unit, lane, rnd, item = got
                 t0 = time.perf_counter()
                 if unit.kind == "zero":
-                    with trace.span("sched.walk", unit=unit.index):
+                    with trace.step_scope(self.epoch_id, rnd), \
+                            trace.span("sched.walk", unit=unit.index), \
+                            steptrace.walk_sink(lane):
                         item = self._handler.reduce_and_update(
                             item, cancel=self._abort
                         )
-                    self._add_busy(time.perf_counter() - t0)
+                    dt = time.perf_counter() - t0
+                    if lane is not None:
+                        lane.note_walk_span(t0 * 1e6, dt * 1e6)
+                    self._add_busy(dt)
                     # the shard is updated: gradients are consumed, so
                     # this unit passes the flush barrier NOW — its
                     # weight all-gather continues downstream and
@@ -691,10 +760,12 @@ class CollectiveScheduler:
                         self._grad_done += 1
                         self._gather_outstanding += 1
                         self._cond.notify_all()
-                    if not self._gatherq.put((unit, item)):
+                    if not self._gatherq.put((unit, lane, rnd, item)):
                         return
                     continue
-                with trace.span("sched.walk", unit=unit.index):
+                with trace.step_scope(self.epoch_id, rnd), \
+                        trace.span("sched.walk", unit=unit.index), \
+                        steptrace.walk_sink(lane):
                     if unit.fused:
                         deferred = self.sess._allreduce_ws(
                             item[0], cancel=self._abort, defer_decode=True
@@ -702,8 +773,11 @@ class CollectiveScheduler:
                     else:
                         self.sess._allreduce_ws(item[0], cancel=self._abort)
                         deferred = None
-                self._add_busy(time.perf_counter() - t0)
-                if not self._gatherq.put((unit, item + (deferred,))):
+                dt = time.perf_counter() - t0
+                if lane is not None:
+                    lane.note_walk_span(t0 * 1e6, dt * 1e6)
+                self._add_busy(dt)
+                if not self._gatherq.put((unit, lane, rnd, item + (deferred,))):
                     return
         except BaseException as e:  # noqa: BLE001 - channeled to flush()
             self._record_error(e)
@@ -721,13 +795,18 @@ class CollectiveScheduler:
                     return
                 if self._abort.is_set():
                     continue  # drain to the sentinel
-                unit, item = got
+                unit, lane, rnd, item = got
                 if unit.kind == "zero":
                     t0 = time.perf_counter()
-                    with trace.span("sched.gather", unit=unit.index):
+                    with trace.step_scope(self.epoch_id, rnd), \
+                            trace.span("sched.gather", unit=unit.index), \
+                            steptrace.walk_sink(lane, gather=True):
                         item = self._handler.gather(item, cancel=self._abort)
-                    self._add_busy(time.perf_counter() - t0)
-                if not self._unpackq.put((unit, item)):
+                    dt = time.perf_counter() - t0
+                    if lane is not None:
+                        lane.note_gather_span(t0 * 1e6, dt * 1e6)
+                    self._add_busy(dt)
+                if not self._unpackq.put((unit, lane, rnd, item)):
                     return
         except BaseException as e:  # noqa: BLE001 - channeled to flush()
             self._record_error(e)
@@ -742,29 +821,37 @@ class CollectiveScheduler:
                     return
                 if self._abort.is_set():
                     continue  # aborted: must not touch caller buffers
-                unit, item = got
+                unit, lane, rnd, item = got
                 t0 = time.perf_counter()
                 if unit.kind == "zero":
-                    with trace.span("sched.unpack", unit=unit.index):
+                    with trace.step_scope(self.epoch_id, rnd), \
+                            trace.span("sched.unpack", unit=unit.index):
                         self._handler.scatter(item, cancel=self._abort)
-                    self._add_busy(time.perf_counter() - t0, queued=-1)
+                    dt = time.perf_counter() - t0
+                    if lane is not None:
+                        lane.note_unpack(dt * 1e6)
+                    self._add_busy(dt, queued=-1)
                     with self._cond:
                         self._gather_outstanding -= 1
                         self._stat["units"] += 1
                         self._stat["zero_units"] += 1
                         self._cond.notify_all()
                     continue
-                if unit.fused:
-                    with trace.span("sched.unpack", unit=unit.index):
-                        self.sess._unpack_bucket(item, self._abort)
-                else:
-                    # single: the walk wrote w.recv in place (the
-                    # wrapper workspace shares the caller's buffers);
-                    # nothing to scatter
-                    deferred = item[4]
-                    if deferred is not None:
-                        deferred.close()
-                self._add_busy(time.perf_counter() - t0, queued=-1)
+                with trace.step_scope(self.epoch_id, rnd):
+                    if unit.fused:
+                        with trace.span("sched.unpack", unit=unit.index):
+                            self.sess._unpack_bucket(item, self._abort)
+                    else:
+                        # single: the walk wrote w.recv in place (the
+                        # wrapper workspace shares the caller's
+                        # buffers); nothing to scatter
+                        deferred = item[4]
+                        if deferred is not None:
+                            deferred.close()
+                dt = time.perf_counter() - t0
+                if lane is not None:
+                    lane.note_unpack(dt * 1e6)
+                self._add_busy(dt, queued=-1)
                 with self._cond:
                     self._grad_done += 1
                     self._stat["units"] += 1

@@ -40,6 +40,7 @@ from kungfu_tpu.base.workspace import Workspace, even_partition
 from kungfu_tpu.collective import strategies as st
 from kungfu_tpu.collective.codec import DeferredDecode
 from kungfu_tpu.collective.profiler import WalkProfile, get_walk_profiler
+from kungfu_tpu.telemetry import steptrace
 from kungfu_tpu.plan import topology as topo
 from kungfu_tpu.plan.graph import Graph
 from kungfu_tpu.plan.peer import PeerID
@@ -175,17 +176,27 @@ class WalkEngine:
         wall: float,
         prof: WalkProfile,
         dsts=None,
+        sink=None,
     ) -> None:
         """Feed one finished allreduce walk to the process profiler,
         scored against the slowest link the walk used (all estimated
-        links when `dsts` is None — graph walks fan out over many)."""
-        link_bw = None
+        links when `dsts` is None — graph walks fan out over many).
+        `sink` (a captured steptrace sink, ISSUE 13) additionally gets
+        the same attribution with the walk's dominant edge — the ring's
+        successor when the walk names one, else the slowest estimated
+        link — so the step timeline can name the blocking edge."""
+        link_dst = link_bw = None
         if self._links is not None:
-            _, link_bw = self._links.min_bandwidth(dsts)
+            link_dst, link_bw = self._links.min_bandwidth(dsts)
         get_walk_profiler().record(
             self._wire_kind, strategy_label, k, payload_bytes,
             wall, prof.wait, prof.send, link_bw,
         )
+        if sink is not None:
+            edge = str(dsts[0]) if dsts else link_dst
+            steptrace.note_walk(
+                sink, strategy_label, wall, prof.wait, prof.send, edge
+            )
 
     def _walk_label(self) -> str:
         """Strategy label for graph-walk wire accounting. Labels the
@@ -299,6 +310,11 @@ class WalkEngine:
         if self.rank not in members or k == 1:
             w.forward()
             return None
+        # capture the step-plane sink on THIS thread before any work:
+        # the attribution calls at walk end run here too, but capturing
+        # once keeps the contract identical to the graph walk's (whose
+        # chunk jobs hop to pool threads)
+        steptrace_sink = steptrace.current_sink()
         sched = topo.gen_segmented_schedule(members, members.index(self.rank))
         bounds = even_partition(w.recv.size, k)
         w.forward()  # seed the accumulator with own contribution
@@ -559,7 +575,7 @@ class WalkEngine:
             # profiler's efficiency ratio stays meaningful
             self._record_walk(
                 Strategy.RING_SEGMENTED.name, k, w.recv.nbytes // 2, wall,
-                prof, dsts=[send_peer],
+                prof, dsts=[send_peer], sink=steptrace_sink,
             )
             return None
         if wire is not None:
@@ -596,7 +612,7 @@ class WalkEngine:
         self._record_walk(
             Strategy.RING_SEGMENTED.name, k,
             w.recv.nbytes if phase == "all" else w.recv.nbytes // 2,
-            wall, prof, dsts=[send_peer],
+            wall, prof, dsts=[send_peer], sink=steptrace_sink,
         )
         return deferred
 
@@ -621,11 +637,15 @@ class WalkEngine:
         chunks = w.split(even_partition, k) if k > 1 else [w]
         if cancel is None:
             cancel = threading.Event()
+        # capture the step-plane sink HERE (the submitting walk thread):
+        # chunk jobs execute on pool threads, where the thread-local
+        # sink of the scheduler's walker would be invisible
+        sink = steptrace.current_sink()
         if k == 1:
             pair = strategies[0]
             self._run_graphs(
                 chunks[0], [pair.reduce_graph, pair.bcast_graph], cancel,
-                wire, profile=True,
+                wire, profile=True, sink=sink,
             )
             return
         jobs = []
@@ -634,7 +654,7 @@ class WalkEngine:
             jobs.append(
                 lambda c=chunk, p=pair: self._run_graphs(
                     c, [p.reduce_graph, p.bcast_graph], cancel, wire,
-                    profile=True,
+                    profile=True, sink=sink,
                 )
             )
         _par(jobs, self.timeout, cancel)
@@ -646,6 +666,7 @@ class WalkEngine:
         cancel: Optional[threading.Event] = None,
         wire: Optional[DType] = None,
         profile: bool = False,
+        sink=None,
     ) -> None:
         """The hot walk; parity: runGraphs (session.go:231-299).
 
@@ -899,4 +920,6 @@ class WalkEngine:
         if prof is not None:
             # graph walks fan out over many edges: score against the
             # slowest estimated link overall (dsts=None)
-            self._record_walk(wire_label, self.size, w.recv.nbytes, wall, prof)
+            self._record_walk(
+                wire_label, self.size, w.recv.nbytes, wall, prof, sink=sink
+            )

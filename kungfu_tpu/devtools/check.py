@@ -10,6 +10,8 @@ set ONCE (per-file cache and all) and sections the report by concern:
 - ``[kfcheck]``      the code rules (KF0xx–KF5xx, KF7xx)
 - ``[knobs-doc]``    docs/knobs.md vs the knob registry (KF102)
 - ``[metric-docs]``  docs/telemetry.md vs registered families (KF600/601)
+- ``[span-docs]``    docs/telemetry.md's span table vs emitted span
+  kinds (KF602, ISSUE 13 satellite)
 
 Exit status is the contract — 0 clean, 1 findings — matching the
 kfcheck CLI. ``tests/test_kfcheck.py`` invokes it as the tier-1 gate;
@@ -28,6 +30,7 @@ from kungfu_tpu.devtools.kfcheck import core
 
 _DOC_RULES_KNOBS = ("KF102",)
 _DOC_RULES_METRICS = ("KF600", "KF601")
+_DOC_RULES_SPANS = ("KF602",)
 
 
 def _section(findings: List["core.Finding"], title: str, rules) -> List[str]:
@@ -49,12 +52,16 @@ def main(argv=None) -> int:
 
     core._ensure_rules_loaded()
     findings = core.run_project(use_cache=not args.no_cache)
-    doc_rules = set(_DOC_RULES_KNOBS) | set(_DOC_RULES_METRICS)
+    doc_rules = (
+        set(_DOC_RULES_KNOBS) | set(_DOC_RULES_METRICS)
+        | set(_DOC_RULES_SPANS)
+    )
     code = [f for f in findings if f.rule not in doc_rules]
     out: List[str] = []
     out.extend(_section(code, "kfcheck", None))
     out.extend(_section(findings, "knobs-doc", _DOC_RULES_KNOBS))
     out.extend(_section(findings, "metric-docs", _DOC_RULES_METRICS))
+    out.extend(_section(findings, "span-docs", _DOC_RULES_SPANS))
     n = len(findings)
     out.append(
         "check: clean" if n == 0

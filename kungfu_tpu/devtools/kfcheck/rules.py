@@ -917,6 +917,119 @@ def check_metric_ghosts(project: Project) -> List[Finding]:
     return out
 
 
+# KF602 — span-doc lint (ISSUE 13 satellite): the span-kind shape of
+# KF600/601 in one bidirectional rule. Every span-kind LITERAL emitted
+# through the tracer (trace.span / trace.record / tracing.instant /
+# trace.step spans) must appear in docs/telemetry.md's span table, and
+# every table row must still exist in code. Dynamic names (f-strings —
+# `collective.{kind}`, `host.walk[NMiB]`) are out of the table's scope
+# and stay documented in the prose "Span naming scheme" section; kinds
+# passed through a parameter indirection are declared in
+# _SPAN_INDIRECT so the scan stays honest about its blind spot.
+
+_SPAN_FNS = frozenset({"span", "record", "instant"})
+_SPAN_MODULES = frozenset({"trace", "tracing"})
+_SPAN_INDIRECT = frozenset({
+    # walks.timed_step forwards its span_name parameter to trace.span
+    "host.rs.step",
+    "host.ag.step",
+})
+
+_SPAN_TABLE_HEADING = "## Span table"
+
+
+def _source_span_names(project: Project) -> Set[str]:
+    names: Set[str] = set()
+    for ctx in project.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr in _SPAN_FNS
+                and _last_segment(fn.value) in _SPAN_MODULES
+            ):
+                continue
+            if (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                names.add(node.args[0].value)
+    return names
+
+
+def _span_table_rows(project: Project) -> Optional[List[Tuple[int, str]]]:
+    """(lineno, span name) per row of the docs/telemetry.md span table,
+    or None when the doc/heading is missing."""
+    got = _telemetry_doc(project)
+    if got is None:
+        return None
+    _, lines = got
+    rows: List[Tuple[int, str]] = []
+    in_table = False
+    for i, line in enumerate(lines, start=1):
+        if line.strip() == _SPAN_TABLE_HEADING:
+            in_table = True
+            continue
+        if in_table and line.startswith("## "):
+            break
+        if in_table and line.startswith("| `"):
+            for name in re.findall(r"`([a-z0-9_.]+)`", line.split("|")[1]):
+                rows.append((i, name))
+    return rows if in_table else None
+
+
+@rule(
+    "KF602",
+    "span-doc-lint",
+    "every span-kind literal emitted through the tracer must appear in "
+    "docs/telemetry.md's span table AND every table row must still "
+    "exist in code — the span table is the operator's legend for every "
+    "/trace and /cluster/trace view (the KF600/601 contract, for spans)",
+    scope="project",
+)
+def check_spans_documented(project: Project) -> List[Finding]:
+    names = _source_span_names(project) | _SPAN_INDIRECT
+    out: List[Finding] = []
+    if len(names) <= 15:
+        # the scan must keep finding the tracer call sites — a rename
+        # must not silently turn this rule into a no-op
+        out.append(Finding(
+            "KF602", "docs/telemetry.md", 1,
+            f"span-kind scan found only {len(names)} literals — the AST "
+            "scan looks broken (tracer rename?), fix the rule before "
+            "trusting it",
+        ))
+        return out
+    rows = _span_table_rows(project)
+    if rows is None:
+        return [Finding(
+            "KF602", "docs/telemetry.md", 1,
+            f"docs/telemetry.md has no `{_SPAN_TABLE_HEADING}` section — "
+            "add the span table (one row per span kind)",
+        )]
+    documented = {name for _, name in rows}
+    for name in sorted(names - documented):
+        out.append(Finding(
+            "KF602", "docs/telemetry.md", 1,
+            f"span kind {name!r} is emitted in the package but absent "
+            "from docs/telemetry.md's span table — add a row",
+        ))
+    for lineno, name in rows:
+        if name not in names:
+            out.append(Finding(
+                "KF602", "docs/telemetry.md", lineno,
+                f"docs/telemetry.md's span table documents {name!r} but "
+                "no code emits it — drop the stale row (dynamic-name "
+                "spans belong in the prose section, not the table)",
+            ))
+    return out
+
+
 # ---------------------------------------------------------------------
 # KF7xx — distributed protocol (ISSUE 12: the first cross-module rules)
 # ---------------------------------------------------------------------

@@ -1,6 +1,7 @@
 """Usage: python3 -m kungfu_tpu.info [--no-devices] [--telemetry [URL]]
        python3 -m kungfu_tpu.info top [--watch] [--interval S] [URL]
        python3 -m kungfu_tpu.info links [--watch] [--interval S] [URL]
+       python3 -m kungfu_tpu.info steps [--watch] [--interval S] [-n N] [URL]
        python3 -m kungfu_tpu.info postmortem [DIR|URL]
 
 Prints framework, backend and cluster-env diagnostics (parity:
@@ -25,6 +26,12 @@ edge the passively-measured EWMA bandwidth (MiB/s) from the runner's
 with `!`. Point it at the runner debug endpoint (or it derives the URL
 from KF_CLUSTER_HEALTH_URL). This is the "which link is slow?" view —
 see the runbook in docs/telemetry.md.
+
+`steps` renders the step plane (ISSUE 13): recent merged training
+steps from the runner's /cluster/steps endpoint as aligned per-peer
+lanes, the critical (peer, bucket, edge) chain highlighted with `*`,
+plus each step's overlap and queue-delay fractions. This is the "why
+is this step slow?" view — see the runbook in docs/telemetry.md.
 
 `postmortem` reconstructs the death timeline of crashed workers
 (ISSUE 3): point it at a telemetry run dir (KF_TELEMETRY_DIR, default
@@ -118,6 +125,64 @@ def _show_telemetry(argv) -> None:
         print(d["metrics"])
 
 
+def _interval_flag(argv, cmd: str):
+    """Parse --interval seconds (default 2.0); (None, rc) on bad input."""
+    if "--interval" not in argv:
+        return 2.0, None
+    idx = argv.index("--interval")
+    try:
+        return float(argv[idx + 1]), None
+    except (IndexError, ValueError):
+        print(f"info {cmd}: --interval wants seconds, e.g. --interval 2",
+              file=sys.stderr)
+        return None, 2
+
+
+def _cluster_url(argv, endpoint: str) -> str:
+    """Resolve a /cluster/<endpoint> URL: explicit argument (full path
+    or debug-endpoint base), else derived from KF_CLUSTER_HEALTH_URL —
+    shared by the top/links/steps commands so the suffix munging can't
+    drift between them."""
+    urls = [a for a in argv if a.startswith("http")]
+    url = urls[0] if urls else knobs.raw("KF_CLUSTER_HEALTH_URL")
+    if not url:
+        return ""
+    url = url.rstrip("/")
+    if url.endswith("/cluster/health"):
+        url = url[: -len("/cluster/health")]
+    if not url.endswith(endpoint):
+        url += endpoint
+    return url
+
+
+def _fetch_render_loop(cmd: str, url: str, render, watch: bool,
+                       interval: float) -> int:
+    """The shared fetch-JSON → render → print/refresh loop behind the
+    one-shot and --watch modes of top/links/steps. Watch mode rides out
+    transient fetch blips (runner mid-restart) instead of killing the
+    live view; the whole iteration is interruptible."""
+    while True:
+        try:
+            try:
+                with urllib.request.urlopen(url, timeout=5) as r:
+                    frame = render(json.loads(r.read().decode()))
+            except (OSError, ValueError) as e:
+                if not watch:
+                    print(f"info {cmd}: fetch {url} failed: {e}",
+                          file=sys.stderr)
+                    return 1
+                frame = f"info {cmd}: fetch failed, retrying: {e}"
+            if watch:
+                # home + clear-to-end keeps the view refreshing in place
+                print("\x1b[H\x1b[2J" + frame, flush=True)
+                time.sleep(interval)
+            else:
+                print(frame)
+                return 0
+        except KeyboardInterrupt:
+            return 0
+
+
 def _fmt_num(v, fmt="{:.1f}", dash="-") -> str:
     return fmt.format(v) if isinstance(v, (int, float)) else dash
 
@@ -134,9 +199,15 @@ def _fmt_bytes(v) -> str:
 
 def render_top(health: dict) -> str:
     """One refresh frame of `info top`: a fixed-width table over
-    /cluster/health, stragglers flagged in the last column."""
+    /cluster/health, stragglers flagged in the last column. The CRIT%
+    and CRIT-EDGE columns come from the step plane (ISSUE 13): the share
+    of recent merged steps this peer was elected critical in, and the
+    blocking edge those elections named."""
+    steps = health.get("steps") or {}
+    crit_frac = steps.get("crit_frac") or {}
+    crit_edge = steps.get("crit_edge") or {}
     cols = ("PEER", "STEP/S", "P50(ms)", "P99(ms)", "TX", "RX",
-            "RTT(ms)", "AGE(s)", "FLAGS")
+            "RTT(ms)", "AGE(s)", "CRIT%", "CRIT-EDGE", "FLAGS")
     rows = [cols]
     peers = health.get("peers", {})
     for label in sorted(peers):
@@ -148,6 +219,7 @@ def render_top(health: dict) -> str:
             flags.append("RTT")
         if p.get("error"):
             flags.append("UNREACHABLE")
+        cf = crit_frac.get(label)
         rows.append((
             label,
             _fmt_num(p.get("step_rate"), "{:.2f}"),
@@ -157,6 +229,8 @@ def render_top(health: dict) -> str:
             _fmt_bytes(p.get("bytes_rx")),
             _fmt_num(p.get("rtt_ms"), "{:.2f}"),
             _fmt_num(p.get("last_scrape_age_s")),
+            f"{cf:.0%}" if isinstance(cf, (int, float)) else "-",
+            f"→{crit_edge[label]}" if label in crit_edge else "-",
             ",".join(flags) or "ok",
         ))
     widths = [max(len(str(r[i])) for r in rows) for i in range(len(cols))]
@@ -169,22 +243,27 @@ def render_top(health: dict) -> str:
         + (f", step skew {skew:.2f}x" if isinstance(skew, (int, float)) else "")
         + (f", STRAGGLERS: {', '.join(stragglers)}" if stragglers else "")
     )
+    crit_peer = steps.get("critical_peer")
+    if crit_peer:
+        edge = steps.get("critical_edge")
+        ov = steps.get("overlap_frac")
+        summary += (
+            f"; last step critical: {crit_peer}"
+            + (f" →{edge}" if edge else "")
+            + (
+                f", overlap {ov:.0%}"
+                if isinstance(ov, (int, float)) else ""
+            )
+        )
     return "\n".join([summary] + lines)
 
 
 def _cmd_top(argv) -> int:
     watch = "--watch" in argv
-    interval = 2.0
-    if "--interval" in argv:
-        idx = argv.index("--interval")
-        try:
-            interval = float(argv[idx + 1])
-        except (IndexError, ValueError):
-            print("info top: --interval wants seconds, e.g. --interval 2",
-                  file=sys.stderr)
-            return 2
-    urls = [a for a in argv if a.startswith("http")]
-    url = urls[0] if urls else knobs.raw("KF_CLUSTER_HEALTH_URL")
+    interval, rc = _interval_flag(argv, "top")
+    if rc is not None:
+        return rc
+    url = _cluster_url(argv, "/cluster/health")
     if not url:
         print(
             "info top: no /cluster/health URL — pass one, or run under "
@@ -192,33 +271,7 @@ def _cmd_top(argv) -> int:
             file=sys.stderr,
         )
         return 2
-    while True:
-        # the whole iteration is interruptible: Ctrl-C mostly lands
-        # inside the urlopen (5s timeout dwarfs the sleep on a sick
-        # runner), and "until interrupted" means a clean exit there too
-        try:
-            try:
-                with urllib.request.urlopen(url, timeout=5) as r:
-                    health = json.loads(r.read().decode())
-                frame = render_top(health)
-            except (OSError, ValueError) as e:
-                # watch mode rides out transient blips (runner
-                # mid-restart, one slow scrape) instead of killing the
-                # live view
-                if not watch:
-                    print(f"info top: fetch {url} failed: {e}",
-                          file=sys.stderr)
-                    return 1
-                frame = f"info top: fetch failed, retrying: {e}"
-            if watch:
-                # home + clear-to-end keeps the table refreshing in place
-                print("\x1b[H\x1b[2J" + frame, flush=True)
-                time.sleep(interval)
-            else:
-                print(frame)
-                return 0
-        except KeyboardInterrupt:
-            return 0
+    return _fetch_render_loop("top", url, render_top, watch, interval)
 
 
 def render_links(doc: dict) -> str:
@@ -269,33 +322,12 @@ def render_links(doc: dict) -> str:
     return "\n".join([summary] + lines + [notes, "peers:"] + legend)
 
 
-def _links_url(argv) -> str:
-    """Resolve the /cluster/links URL: explicit argument (full path or
-    debug-endpoint base), else derived from KF_CLUSTER_HEALTH_URL."""
-    urls = [a for a in argv if a.startswith("http")]
-    url = urls[0] if urls else knobs.raw("KF_CLUSTER_HEALTH_URL")
-    if not url:
-        return ""
-    url = url.rstrip("/")
-    if url.endswith("/cluster/health"):
-        url = url[: -len("/cluster/health")]
-    if not url.endswith("/cluster/links"):
-        url += "/cluster/links"
-    return url
-
-
 def _cmd_links(argv) -> int:
     watch = "--watch" in argv
-    interval = 2.0
-    if "--interval" in argv:
-        idx = argv.index("--interval")
-        try:
-            interval = float(argv[idx + 1])
-        except (IndexError, ValueError):
-            print("info links: --interval wants seconds, e.g. --interval 2",
-                  file=sys.stderr)
-            return 2
-    url = _links_url(argv)
+    interval, rc = _interval_flag(argv, "links")
+    if rc is not None:
+        return rc
+    url = _cluster_url(argv, "/cluster/links")
     if not url:
         print(
             "info links: no /cluster/links URL — pass one (or a runner "
@@ -304,26 +336,71 @@ def _cmd_links(argv) -> int:
             file=sys.stderr,
         )
         return 2
-    while True:
+    return _fetch_render_loop("links", url, render_links, watch, interval)
+
+
+def render_steps(doc: dict, limit: int = 8) -> str:
+    """One frame of `info steps`: the newest merged steps (newest last)
+    as aligned per-peer lanes with the critical chain called out —
+    rendering shared with the flight postmortem (steptrace.render_step)
+    so the live view and the black box read identically."""
+    from kungfu_tpu.telemetry import steptrace
+
+    steps = doc.get("steps") or []
+    if not steps:
+        return (
+            "no merged steps yet — the step plane needs the async "
+            "scheduler (KF_CONFIG_ASYNC=on|auto) and at least one "
+            "recorded round per worker (KF_TELEMETRY_SPAN_SAMPLE > 0)"
+        )
+    shown = steps[-limit:]
+    lines: list = [
+        f"{len(steps)} merged steps on record, showing {len(shown)} "
+        "(lanes: · queued  ≈ wait  ■ compute  > send  g gather tail; "
+        "* = critical peer)"
+    ]
+    for s in shown:
+        lines.append("")
+        lines.extend(steptrace.render_step(s))
+        chain = s.get("chain") or []
+        if len(chain) > 1:
+            tail = ", ".join(
+                f"{c['peer']}#{c['bucket']}"
+                + (f"→{c['edge']}" if c.get("edge") else "")
+                + f" {c['self_us'] / 1e3:.1f}ms"
+                for c in chain[1:]
+            )
+            lines.append(f"   chain tail: {tail}")
+    return "\n".join(lines)
+
+
+def _cmd_steps(argv) -> int:
+    watch = "--watch" in argv
+    interval, rc = _interval_flag(argv, "steps")
+    if rc is not None:
+        return rc
+    limit = 8
+    if "-n" in argv:
+        idx = argv.index("-n")
         try:
-            try:
-                with urllib.request.urlopen(url, timeout=5) as r:
-                    doc = json.loads(r.read().decode())
-                frame = render_links(doc)
-            except (OSError, ValueError) as e:
-                if not watch:
-                    print(f"info links: fetch {url} failed: {e}",
-                          file=sys.stderr)
-                    return 1
-                frame = f"info links: fetch failed, retrying: {e}"
-            if watch:
-                print("\x1b[H\x1b[2J" + frame, flush=True)
-                time.sleep(interval)
-            else:
-                print(frame)
-                return 0
-        except KeyboardInterrupt:
-            return 0
+            limit = max(1, int(argv[idx + 1]))
+        except (IndexError, ValueError):
+            print("info steps: -n wants a step count, e.g. -n 4",
+                  file=sys.stderr)
+            return 2
+    url = _cluster_url(argv, "/cluster/steps")
+    if not url:
+        print(
+            "info steps: no /cluster/steps URL — pass one (or a runner "
+            "debug endpoint), or run under kfrun -w -debug-port N "
+            "(which exports KF_CLUSTER_HEALTH_URL)",
+            file=sys.stderr,
+        )
+        return 2
+    return _fetch_render_loop(
+        "steps", url, lambda doc: render_steps(doc, limit=limit),
+        watch, interval,
+    )
 
 
 def _cmd_postmortem(argv) -> int:
@@ -373,6 +450,8 @@ def main(argv) -> None:
         sys.exit(_cmd_top(argv[1:]))
     if argv and argv[0] == "links":
         sys.exit(_cmd_links(argv[1:]))
+    if argv and argv[0] == "steps":
+        sys.exit(_cmd_steps(argv[1:]))
     if argv and argv[0] == "postmortem":
         sys.exit(_cmd_postmortem(argv[1:]))
     _show_versions()

@@ -247,6 +247,12 @@ _knob("KF_TELEMETRY_SPAN_SAMPLE", "1.0", _float,
 _knob("KF_TRACE_BUFFER", "8192", _int,
       "Span ring-buffer capacity (events) for the /trace view.",
       section=_SEC_TELEMETRY, kind="int")
+_knob("KF_STEP_TIMELINE_KEEP", "16", _int,
+      "Step-trace ring size: how many recent per-step critical-path "
+      "timelines each worker keeps (served at /steptrace, merged into "
+      "/cluster/steps, journaled by the flight recorder). 0 disables "
+      "the step plane entirely.",
+      section=_SEC_TELEMETRY, kind="int")
 
 _SEC_FLIGHT = "Flight recorder"
 _knob("KF_FLIGHT", "", _bool,
@@ -401,6 +407,14 @@ _knob("KF_DEBUG_PROTOCOL", "", _bool,
       "`kungfu_debug_protocol_*` metrics — before the rendezvous hang, "
       "not after. Off = protowatch never imported, hot path untouched.",
       section=_SEC_DEBUG, kind="bool")
+_knob("KF_TEST_SLOW_EDGE", "", _str,
+      "Test-only fault injection for the step plane's e2e: delay every "
+      "transport send over one directed edge. Format `[src>]dst=ms` "
+      "with src/dst as `host:port` peer specs — `38001>…:38002=40` "
+      "adds 40 ms to each send from the worker whose KF_SELF_SPEC is "
+      "src toward dst (src omitted: every worker sending to dst). "
+      "Local-only, never set in production.",
+      section=_SEC_DEBUG, kind="str")
 _knob("KF_DEBUG_PROTOCOL_WINDOW", "512", _int,
       "Collective-order sentinel: max recorded entries per check window. "
       "Past the cap, entries fold into the rolling digest (divergence is "

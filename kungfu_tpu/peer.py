@@ -214,6 +214,7 @@ class Peer:
                 peers,
                 self.client,
                 self.collective,
+                cluster_version=self.cluster_version,
             )
             self._peers = peers
             self.epoch_count += 1

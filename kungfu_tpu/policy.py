@@ -22,6 +22,14 @@ own outgoing row) and ``collective/efficiency`` +
 ``collective/wait_frac`` from the walk profiler — the measured inputs
 for straggler-adaptive topology re-planning and the async collective
 scheduler (ROADMAP items 2/5).
+
+The step plane (ISSUE 13) adds ``step/critical_peer`` +
+``step/critical_edge`` (cluster-wide only — electing a critical peer
+needs every peer's timeline) and ``step/overlap_frac`` +
+``step/queue_delay_frac`` (worker-local fallback from this worker's own
+step timelines, overridden by the cluster merge) — per-step measured
+attribution, the inputs ROADMAP items 2 (measured-topology re-planning)
+and 5 (profile-fed submit priorities) consume.
 """
 
 from __future__ import annotations
@@ -122,13 +130,21 @@ class PolicyRunner:
             # is the exact staleness LinkTable.prune exists to prevent
             from kungfu_tpu.collective.host_session import get_walk_profiler
             from kungfu_tpu.telemetry import link as _link
+            from kungfu_tpu.telemetry import steptrace as _steptrace
 
             for key in ("links/min_bw", "links/slowest_edge",
-                        "collective/efficiency", "collective/wait_frac"):
+                        "collective/efficiency", "collective/wait_frac",
+                        "step/overlap_frac", "step/queue_delay_frac",
+                        "step/critical_peer", "step/critical_edge"):
                 self.ctx.metrics.pop(key, None)
             if _link.enabled():
                 self.ctx.metrics.update(_link.get_table().signals())
             self.ctx.metrics.update(get_walk_profiler().signals())
+            # step plane (ISSUE 13): this worker's own overlap/queue
+            # fractions — the cluster-wide merge (which alone can name
+            # step/critical_peer + step/critical_edge) overrides these
+            # below when the runner aggregator is live
+            self.ctx.metrics.update(_steptrace.get_store().local_signals())
         except Exception as e:  # noqa: BLE001 - telemetry must never kill training
             log.debug("policy: walk/link signal refresh failed: %s", e)
         try:

@@ -56,6 +56,7 @@ class DebugServer:
     - ``/cluster/trace``   cross-peer merged Chrome trace
     - ``/cluster/health``  per-peer step rate / straggler JSON
     - ``/cluster/links``   k×k link matrix (per-edge bandwidth/latency)
+    - ``/cluster/steps``   merged per-step critical-path records
     - anything else        the Stage/worker debug dump (old contract)
     """
 
@@ -78,6 +79,11 @@ class DebugServer:
             if path == "/cluster/links":
                 return (
                     json.dumps(agg.cluster_links(), indent=2),
+                    "application/json",
+                )
+            if path == "/cluster/steps":
+                return (
+                    json.dumps(agg.cluster_steps(), indent=2),
                     "application/json",
                 )
             if path == "/cluster/audit":

@@ -63,9 +63,10 @@ __all__ = [
     "promparse",
     "straggler",
     "flight",
+    "steptrace",
 ]
 
-_LAZY_MODULES = ("cluster", "promparse", "straggler", "flight")
+_LAZY_MODULES = ("cluster", "promparse", "straggler", "flight", "steptrace")
 
 
 def __getattr__(name):

@@ -21,7 +21,12 @@ serves it from the watcher's debug endpoint:
   ``kungfu_link_*`` row (passive per-destination EWMA bandwidth/latency
   from real collective traffic) merged into one document, with the
   slowest edge called out — the input signal for straggler-adaptive
-  topology re-planning.
+  topology re-planning;
+- ``/cluster/steps``   — the step plane (ISSUE 13): every worker's
+  ``/steptrace`` ring merged per (session_epoch, round) with the same
+  clock offsets, each step carrying its elected critical (peer, bucket,
+  edge) chain, overlap fraction and queue-delay fraction — "which
+  bucket on which peer over which edge was the long pole" as data.
 
 On top of the snapshot the aggregator runs straggler detection
 (:mod:`~kungfu_tpu.telemetry.straggler`): rolling per-peer step-time
@@ -48,6 +53,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import audit, log, metrics, promparse
 from kungfu_tpu.telemetry import link as tlink
+from kungfu_tpu.telemetry import steptrace as tstep
+from kungfu_tpu.telemetry import straggler as tstraggler
 from kungfu_tpu.telemetry.straggler import StragglerScorer
 
 # metric families scraped off each worker's exposition
@@ -65,6 +72,14 @@ LINK_BYTES = "kungfu_link_tx_bytes_total"
 LINK_MSGS = "kungfu_link_tx_messages_total"
 
 CLOCK_HEADER = "X-KF-Perf-Now-Us"
+
+# step plane (ISSUE 13): how many merged steps the aggregator retains
+# for /cluster/steps and the info-top critical columns, and how many
+# consecutive merged steps the same (peer, edge) must dominate before a
+# `step_critical_path` audit event fires (matches StragglerPolicy's
+# default patience — one noisy step is weather, three is a bottleneck)
+STEP_KEEP = 64
+STEP_CRIT_PATIENCE = 3
 
 DEFAULT_INTERVAL = 5.0
 INTERVAL_ENV = "KF_CLUSTER_SCRAPE_INTERVAL"
@@ -254,6 +269,29 @@ class TelemetryAggregator:
             "kungfu_cluster_scrape_errors_total",
             "Failed peer scrapes",
             ("peer",),
+        )
+        # step plane (ISSUE 13): merged per-step critical-path records,
+        # refreshed from every worker's /steptrace on each sweep
+        self._steps: "collections.deque" = collections.deque(maxlen=STEP_KEEP)
+        self._steps_at: Optional[float] = None  # monotonic, last refresh
+        self._steps_last: Optional[Tuple[int, int]] = None  # newest (e, r)
+        self._crit_streak: Tuple[Optional[Tuple[str, str]], int] = (None, 0)
+        # serializes whole refreshes: the sweep thread and an HTTP
+        # handler's inline staleness refresh both call _refresh_steps,
+        # and two concurrent runs would compute `fresh` against the
+        # same _steps_last — duplicating steps and double-counting the
+        # patience streak. NOT self._lock: a refresh spans HTTP fetches.
+        self._steps_refresh_lock = threading.Lock()
+        self._g_step_overlap = reg.gauge(
+            "kungfu_step_overlap_ratio",
+            "Latest merged step's overlap fraction: scheduler-busy comm "
+            "time hidden under caller compute / total comm time",
+        )
+        self._g_step_critical = reg.gauge(
+            "kungfu_step_critical_seconds",
+            "Latest merged step's critical-path blocking seconds, "
+            "labelled with the elected (peer, edge)",
+            ("peer", "edge"),
         )
 
     # -- membership ----------------------------------------------------
@@ -483,6 +521,10 @@ class TelemetryAggregator:
             t.join(self.timeout + 1.0)
         self._c_scrapes.inc()
         self._scraped_at = time.time()
+        try:
+            self._refresh_steps()
+        except Exception as e:  # noqa: BLE001 - the sweep must outlive a bad step merge
+            log.warn("cluster: step-plane refresh failed: %s", e)
         self._publish()
         return self.cluster_health()
 
@@ -515,12 +557,28 @@ class TelemetryAggregator:
         self._g_stragglers.set(len(flagged))
         # audit on TRANSITIONS only: the log answers "when did peer X
         # become slow", not "is it still slow every 5 seconds"
-        for peer in sorted(flagged - self._flagged):
+        newly_flagged = sorted(flagged - self._flagged)
+        links_doc = None
+        steps: List[dict] = []
+        if newly_flagged:
+            # measured attribution for the event (ISSUE 13 satellite):
+            # the step plane's elected edge when this peer was recently
+            # critical, else the slowest link touching it — both inputs
+            # computed once per transition batch, never per peer
+            links_doc = tlink.merge_matrix(
+                {st.label: st.links for st in self.peers()},
+                copy_edges=False,
+            )
+            with self._lock:
+                steps = list(self._steps)
+        for peer in newly_flagged:
             sc = scores[peer]
+            edge = tstraggler.blocking_edge(peer, steps, links_doc)
             log.warn(
                 "cluster: straggler detected: %s step_time=%.1fms "
-                "(cluster median %.1fms, z=%.1f)",
+                "(cluster median %.1fms, z=%.1f, blocking edge %s)",
                 peer, sc.value * 1e3, (cluster_median or 0) * 1e3, sc.score,
+                "->".join(str(e) for e in edge) if edge else "unknown",
             )
             audit.record_event(
                 "straggler",
@@ -529,6 +587,7 @@ class TelemetryAggregator:
                 score=round(sc.score, 2),
                 step_time_ms=round(sc.value * 1e3, 3),
                 cluster_median_ms=round((cluster_median or 0) * 1e3, 3),
+                blocking_edge=edge,
             )
         for peer in sorted(self._flagged - flagged):
             audit.record_event(
@@ -692,6 +751,179 @@ class TelemetryAggregator:
         }
         return doc
 
+    # -- step plane (ISSUE 13) ------------------------------------------
+
+    # merged step records older than this keep only their election; the
+    # newest few retain the per-peer lanes `info steps` renders (full
+    # lanes for all STEP_KEEP records would hold k x buckets dicts per
+    # step on the runner forever)
+    STEP_LANES_KEEP = 8
+
+    def _refresh_steps(self) -> None:
+        """Pull every worker's /steptrace, align timelines with the
+        clock offsets already estimated for /cluster/trace, merge into
+        per-step critical-path records, publish the gauges and track the
+        patience window behind `step_critical_path` audit events. Only
+        steps NEWER than the last refresh append (workers keep a ring;
+        re-reading it must not replay old steps into the streak), and
+        whole refreshes serialize — the sweep thread and an HTTP
+        handler's inline refresh racing here would append the same
+        fresh steps twice."""
+        with self._steps_refresh_lock:
+            self._refresh_steps_locked()
+
+    def _refresh_steps_locked(self) -> None:
+        docs: Dict[str, dict] = {}
+        offsets: Dict[str, float] = {}
+        for st, body in self._fetch_all("/steptrace"):
+            try:
+                docs[st.label] = json.loads(body.decode())
+            except ValueError as e:
+                st.last_error = str(e)
+                continue
+            offsets[st.label] = st.clock_offset_us or 0.0
+        self._steps_at = time.monotonic()
+        if not docs:
+            return
+        # merge only FLUSHED timelines (an in-flight round's partial
+        # lanes belong to the worker/postmortem views, not a cluster
+        # election), and ALWAYS hold the globally-newest flushed round
+        # back until a newer one exists: a step merges exactly once, so
+        # electing it while some peer is still walking (or unscraped)
+        # would freeze a half-flushed critical path into the ring
+        # forever (seen live: edge=None, overlap=None). Cost: one
+        # step of publication lag, and a fully-quiesced run never
+        # publishes its final round — the price of never publishing a
+        # partial election.
+        for doc in docs.values():
+            doc["timelines"] = [
+                t for t in doc.get("timelines", [])
+                if t.get("t_end_us") is not None
+            ]
+        keys = {
+            (int(t.get("epoch", 0)), int(t.get("round", 0)))
+            for doc in docs.values()
+            for t in doc["timelines"]
+        }
+        merged = tstep.merge_steps(docs, offsets)
+        if keys:
+            newest = max(keys)
+            merged = [
+                s for s in merged if (s["epoch"], s["round"]) < newest
+            ]
+        fresh = [
+            s for s in merged
+            if self._steps_last is None
+            or (s["epoch"], s["round"]) > self._steps_last
+        ]
+        if not fresh:
+            return
+        with self._lock:
+            for s in fresh:
+                rec = dict(s)
+                rec["peer_count"] = len(s.get("peers", {}))
+                self._steps.append(rec)
+            # beyond the lane window, keep only the election (the full
+            # lanes are bulky and already served by the workers)
+            for old in list(self._steps)[:-self.STEP_LANES_KEEP]:
+                old.pop("peers", None)
+            self._steps_last = (fresh[-1]["epoch"], fresh[-1]["round"])
+        latest = fresh[-1]
+        if latest.get("overlap_frac") is not None:
+            self._g_step_overlap.set(latest["overlap_frac"])
+        crit = latest.get("critical")
+        self._g_step_critical.clear_children()
+        if crit:
+            self._g_step_critical.labels(
+                str(crit.get("peer")), str(crit.get("edge") or "?")
+            ).set((crit.get("self_us") or 0.0) / 1e6)
+        # patience window: the SAME (peer, edge) dominating consecutive
+        # merged steps is a standing bottleneck, not weather — audit it
+        # once per streak, at the moment patience fills
+        for s in fresh:
+            c = s.get("critical")
+            key = (
+                (str(c.get("peer")), str(c.get("edge") or ""))
+                if c else None
+            )
+            streak_key, count = self._crit_streak
+            count = count + 1 if key is not None and key == streak_key else 1
+            self._crit_streak = (key, count)
+            if key is not None and count == STEP_CRIT_PATIENCE:
+                audit.record_event(
+                    "step_critical_path",
+                    peer=key[0],
+                    edge=key[1] or None,
+                    bucket=c.get("bucket"),
+                    trigger="step_merge",
+                    blocking_ms=round((c.get("self_us") or 0.0) / 1e3, 3),
+                    steps=STEP_CRIT_PATIENCE,
+                    epoch=s["epoch"],
+                    round=s["round"],
+                )
+
+    def cluster_steps(self) -> dict:
+        """The /cluster/steps view: recent merged per-step critical-path
+        records, newest last — the newest STEP_LANES_KEEP still carry
+        their per-peer lanes (the `info steps` rendering), older ones
+        only the election. Refreshes inline when the cached merge is
+        older than a scrape interval, so one-shot consumers (`info
+        steps` without a runner loop) still see fresh steps."""
+        now = time.monotonic()
+        if self._steps_at is None or now - self._steps_at >= self.interval:
+            try:
+                self._refresh_steps()
+            except Exception as e:  # noqa: BLE001 - serve the cache over a 500
+                log.warn("cluster: inline step refresh failed: %s", e)
+        with self._lock:
+            # shallow copies: a later refresh pops "peers" off aged
+            # records in place, and serialization must not iterate a
+            # dict mid-mutation
+            steps = [dict(s) for s in self._steps]
+        return {
+            "wall_time": time.time(),
+            "count": len(steps),
+            "patience": STEP_CRIT_PATIENCE,
+            "steps": steps,
+        }
+
+    def _steps_summary(self) -> Optional[dict]:
+        """Compact step signal for /cluster/health (the full records
+        stay on /cluster/steps): the latest step's election plus each
+        peer's share of recent steps it was critical in."""
+        with self._lock:
+            steps = list(self._steps)
+        if not steps:
+            return None
+        latest = steps[-1]
+        crit_counts: Dict[str, int] = {}
+        crit_edges: Dict[str, str] = {}
+        for s in steps:
+            c = s.get("critical")
+            if not c or c.get("peer") is None:
+                continue
+            peer = str(c["peer"])
+            crit_counts[peer] = crit_counts.get(peer, 0) + 1
+            if c.get("edge"):
+                crit_edges[peer] = str(c["edge"])
+        n = len(steps)
+        crit = latest.get("critical") or {}
+        return {
+            "steps": n,
+            "critical_peer": crit.get("peer"),
+            "critical_edge": crit.get("edge"),
+            "critical_ms": (
+                round((crit.get("self_us") or 0.0) / 1e3, 3)
+                if crit else None
+            ),
+            "overlap_frac": latest.get("overlap_frac"),
+            "queue_delay_frac": latest.get("queue_delay_frac"),
+            "crit_frac": {
+                p: round(c / n, 3) for p, c in sorted(crit_counts.items())
+            },
+            "crit_edge": crit_edges,
+        }
+
     def _links_summary(self) -> dict:
         """Compact link signal for /cluster/health (the full matrix
         stays on /cluster/links): the slowest measured edge and how many
@@ -780,6 +1012,7 @@ class TelemetryAggregator:
             ),
             "step_skew": self.scorer.skew(),
             "links": self._links_summary(),
+            "steps": self._steps_summary(),
         }
 
 
@@ -901,4 +1134,15 @@ def health_signals(
     if links.get("min_bw") is not None:
         signals["links/min_bw"] = links["min_bw"]
         signals["links/slowest_edge"] = links.get("slowest_edge")
+    # step plane (ISSUE 13): the measured per-step attribution signals
+    # re-planning and priority feedback consume — cluster-wide values
+    # override the worker-local steptrace fallbacks on the shared keys
+    steps = snap.get("steps") or {}
+    if steps.get("steps"):
+        signals["step/critical_peer"] = steps.get("critical_peer")
+        signals["step/critical_edge"] = steps.get("critical_edge")
+        if steps.get("overlap_frac") is not None:
+            signals["step/overlap_frac"] = steps["overlap_frac"]
+        if steps.get("queue_delay_frac") is not None:
+            signals["step/queue_delay_frac"] = steps["queue_delay_frac"]
     return signals

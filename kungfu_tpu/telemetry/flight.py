@@ -43,7 +43,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
-from kungfu_tpu.telemetry import audit, log, metrics, tracing
+from kungfu_tpu.telemetry import audit, log, metrics, steptrace, tracing
 from kungfu_tpu.telemetry.config import env_truthy, truthy
 
 DIR_ENV = "KF_TELEMETRY_DIR"
@@ -379,6 +379,11 @@ class FlightRecorder:
             "open_spans": tracing.open_spans(),
             "audit": audit.to_json()[-AUDIT_TAIL:],
             "log_tail": log.tail(LOG_TAIL),
+            # the step plane's ring (ISSUE 13): the last
+            # KF_STEP_TIMELINE_KEEP per-step timelines, so a postmortem
+            # can say WHERE IN THE STEP the worker died (an unflushed
+            # final timeline names the bucket that never finished)
+            "steps": steptrace.get_store().timelines(),
         }
         rec.update(extra)
         return rec
@@ -616,6 +621,9 @@ def harvest_postmortem(
             else None
         ),
         "last_step": last.get("step") if last else None,
+        "last_step_timeline": (
+            (last.get("steps") or [None])[-1] if last else None
+        ),
         "open_spans": (last.get("open_spans") or {}) if last else {},
         "audit_tail": (last.get("audit") or [])[-10:] if last else [],
         "log_tail": (last.get("log_tail") or [])[-20:] if last else [],
@@ -733,6 +741,12 @@ def render_postmortem(pm: dict) -> str:
         lines.append("open spans at last snapshot:")
         for thread, stack in sorted(open_spans.items()):
             lines.append(f"  {thread}: {' > '.join(stack)}")
+    tl = pm.get("last_step_timeline")
+    if tl:
+        lines.append("final step timeline (where in the step it died):")
+        lines.extend(
+            " " + l for l in steptrace.render_timeline(tl, peer=str(peer))
+        )
     audit_tail = pm.get("audit_tail") or []
     if audit_tail:
         lines.append("final audit events:")

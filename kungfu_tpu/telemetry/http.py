@@ -1,4 +1,5 @@
-"""Per-worker telemetry HTTP endpoint: /metrics + /trace + /audit.
+"""Per-worker telemetry HTTP endpoint: /metrics + /trace + /audit +
+/steptrace.
 
 One server per worker replaces the bespoke /metrics-only server that
 used to live in monitor/net.py (parity: the reference peer's
@@ -9,7 +10,9 @@ serve the whole telemetry subsystem):
   (plus attached renderers, e.g. the net monitor's windowed rates);
 - ``/trace``    Chrome-trace JSON of the span ring buffer
   (load in chrome://tracing or ui.perfetto.dev);
-- ``/audit``    the resize/strategy audit log as JSON.
+- ``/audit``    the resize/strategy audit log as JSON;
+- ``/steptrace`` the step plane's recent per-step timelines (ISSUE 13)
+  with the perf-clock anchors the cluster merge aligns on.
 
 Shutdown is clean: ``stop()`` both shuts the serve loop down AND closes
 the listening socket, so a stopped peer never leaks its telemetry port
@@ -32,6 +35,14 @@ from kungfu_tpu.telemetry import audit, metrics, tracing
 # traces from many workers onto one timeline
 CLOCK_HEADER = "X-KF-Perf-Now-Us"
 WALL_HEADER = "X-KF-Wall-Time-S"
+
+
+def _steptrace_doc() -> dict:
+    # lazy: most processes serving /metrics never record a step, and the
+    # store's knobs should resolve at first USE, not server construction
+    from kungfu_tpu.telemetry import steptrace
+
+    return steptrace.get_store().export()
 
 
 class TelemetryServer:
@@ -59,6 +70,10 @@ class TelemetryServer:
             ),
             "/audit": lambda: (
                 json.dumps(audit.to_json()),
+                "application/json",
+            ),
+            "/steptrace": lambda: (
+                json.dumps(_steptrace_doc()),
                 "application/json",
             ),
         }

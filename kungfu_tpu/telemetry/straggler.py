@@ -130,3 +130,37 @@ class StragglerScorer:
         if cluster <= 0:
             return None
         return max(meds.values()) / cluster
+
+
+def blocking_edge(
+    peer: str,
+    steps: Optional[List[dict]] = None,
+    links: Optional[dict] = None,
+) -> Optional[List[Optional[str]]]:
+    """The measured edge behind a flagged straggler (ISSUE 13 satellite):
+    a z-score says *who* is slow, this says *where* — so the straggler
+    audit event can name the blocking (src, dst) instead of only a
+    duration.
+
+    Preference order: the most recent merged step whose critical peer IS
+    the flagged one (its elected edge is the direct measurement), else
+    the slowest estimated link touching the peer in the k×k matrix
+    (``merge_matrix`` document), else None — a compute straggler has no
+    edge and should not get a fabricated one."""
+    for s in reversed(steps or []):
+        c = s.get("critical")
+        if c and str(c.get("peer")) == str(peer) and c.get("edge"):
+            return [str(peer), str(c["edge"])]
+    worst: Optional[List[Optional[str]]] = None
+    worst_bw: Optional[float] = None
+    for src, row in ((links or {}).get("edges") or {}).items():
+        for dst, info in row.items():
+            if str(peer) not in (str(src), str(dst)):
+                continue
+            bw = info.get("bw")
+            if not isinstance(bw, (int, float)) or bw <= 0:
+                continue
+            if worst_bw is None or bw < worst_bw:
+                worst_bw = float(bw)
+                worst = [str(src), str(dst)]
+    return worst

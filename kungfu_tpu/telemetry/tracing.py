@@ -92,6 +92,54 @@ def _append(ev: TraceEvent) -> None:
         _events.append(ev)
 
 
+# ---------------------------------------------------------------------------
+# step context (ISSUE 13): spans recorded while a (session_epoch, round)
+# scope is active carry it as a `step` arg, so a cross-peer trace merge
+# can group every peer's sched.*/host.*/zero.* spans by training step.
+# Per-thread — the scheduler's worker threads each enter the scope of
+# the round they are executing, which may differ from the round the
+# submitting thread is already producing.
+# ---------------------------------------------------------------------------
+
+_step_tls = threading.local()
+
+
+class _StepScope:
+    __slots__ = ("step", "prev")
+
+    def __init__(self, epoch: int, round_: int):
+        self.step = (int(epoch), int(round_))
+
+    def __enter__(self):
+        self.prev = getattr(_step_tls, "cur", None)
+        _step_tls.cur = self.step
+        return self
+
+    def __exit__(self, *exc):
+        _step_tls.cur = self.prev
+        return False
+
+
+def step_scope(epoch: int, round_: int) -> _StepScope:
+    """Stamp every span/record/instant on this thread with
+    ``step=[epoch, round]`` until exit: ``with step_scope(3, 17): ...``."""
+    return _StepScope(epoch, round_)
+
+
+def current_step() -> Optional[Tuple[int, int]]:
+    """The thread's active (session_epoch, round), or None."""
+    return getattr(_step_tls, "cur", None)
+
+
+def _step_args(args: Optional[dict]) -> Optional[dict]:
+    cur = getattr(_step_tls, "cur", None)
+    if cur is None:
+        return args
+    d = dict(args) if args else {}
+    d.setdefault("step", list(cur))
+    return d
+
+
 class _Span:
     """Class-based context manager (NOT @contextmanager: spans sit on
     every collective/transport call and generator CMs cost ~3x more to
@@ -117,7 +165,7 @@ class _Span:
         _append(
             TraceEvent(
                 self.name, self.t0, dt, threading.get_ident(), self.depth,
-                "X", self.args,
+                "X", _step_args(self.args),
             )
         )
         return False
@@ -139,7 +187,7 @@ def record(name: str, duration_s: float, **args) -> None:
             threading.get_ident(),
             len(_stack()),
             "X",
-            args or None,
+            _step_args(args or None),
         )
     )
 
@@ -149,7 +197,7 @@ def instant(name: str, **args) -> None:
     _append(
         TraceEvent(
             name, time.perf_counter(), 0.0, threading.get_ident(),
-            len(_stack()), "i", args or None,
+            len(_stack()), "i", _step_args(args or None),
         )
     )
 

@@ -14,11 +14,13 @@ from kungfu_tpu.telemetry.tracing import (  # noqa: F401
     chrome_trace,
     chrome_trace_json,
     clear,
+    current_step,
     events,
     export_chrome,
     full_events,
     instant,
     record,
     span,
+    step_scope,
     summary_ms,
 )

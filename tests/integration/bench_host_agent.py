@@ -28,6 +28,8 @@ def main() -> None:
         argv += ["--async"]
     if os.environ.get("KF_BENCH_ZERO", ""):
         argv += ["--zero"]
+    if os.environ.get("KF_BENCH_STEPS", ""):
+        argv += ["--steps"]
     sys.argv = argv
     from kungfu_tpu.benchmarks.__main__ import main as bench_main
 

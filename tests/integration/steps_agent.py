@@ -1,0 +1,84 @@
+"""Step-plane agent (ISSUE 13 acceptance): drives async scheduler
+rounds so every worker records step timelines, asserts the worker-local
+plane (recorded timelines, step/* PolicyContext signals), then keeps
+stepping until the harness confirms the cluster-side merge named the
+injected slow edge on /cluster/steps (KF_TEST_DONE_FILE), so the
+runner-side window is bounded by the test, not a fixed sleep.
+
+Run with KF_CONFIG_ASYNC=on and (for a deterministic ring successor)
+KF_CONFIG_ALGO=segmented; the harness injects KF_TEST_SLOW_EDGE so one
+peer's sends toward its ring successor carry a fixed delay.
+"""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from kungfu_tpu import api
+
+
+def main() -> int:
+    rank = api.current_rank()
+    size = api.cluster_size()
+    expected = size * (size + 1) / 2
+
+    # 4 x 4MB f32 tensors: over SEGMENT_MIN_BYTES so the ring walks, one
+    # fused bucket under the 64MB cap — the lane set stays readable
+    grads = [
+        np.full(1_000_000, float(rank + 1), np.float32) for _ in range(4)
+    ]
+    outs = [np.empty_like(g) for g in grads]
+
+    def one_round(i: int) -> None:
+        res = api.group_all_reduce_async(grads, name="step", outs=outs)
+        res.wait()
+        assert np.all(outs[0] == expected), f"allreduce wrong: {outs[0][:4]}"
+
+    # registration round + enough recorded rounds that the acceptance's
+    # "named within 5 steps" window exists on every peer's ring
+    for i in range(8):
+        one_round(i)
+
+    from kungfu_tpu.telemetry import steptrace
+
+    tls = steptrace.get_store().timelines()
+    flushed = [t for t in tls if t.get("busy_us")]
+    assert flushed, f"no recorded step timelines: {tls}"
+    t = flushed[-1]
+    assert t["buckets"], t
+    b = t["buckets"][0]
+    assert b["walk_us"] > 0 and b["edge"], b
+    assert t["overlap_frac"] is not None, t
+
+    # worker-local half of the policy-signal acceptance
+    from kungfu_tpu.policy import PolicyRunner
+
+    with PolicyRunner([], batch_size=8) as runner:
+        with runner.step():
+            pass
+    m = runner.ctx.metrics
+    assert "step/overlap_frac" in m, sorted(m)
+    assert 0.0 <= m["step/overlap_frac"] <= 1.0, m["step/overlap_frac"]
+    assert "step/queue_delay_frac" in m, sorted(m)
+
+    # keep stepping until the harness saw /cluster/steps (or give up
+    # after 60s — the runner must still exit 0)
+    done_file = os.environ.get("KF_TEST_DONE_FILE", "")
+    deadline = time.time() + 60
+    i = 8
+    while time.time() < deadline:
+        if done_file and os.path.exists(done_file):
+            break
+        one_round(i)
+        i += 1
+        time.sleep(0.2)
+
+    api.run_barrier()
+    print(f"steps agent done rank={rank}")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -623,17 +623,23 @@ class TestInfoLinks:
         assert "no peers" in render_links({"peers": [], "edges": {}})
 
     def test_url_derivation(self, monkeypatch):
-        from kungfu_tpu.info.__main__ import _links_url
+        # _cluster_url is the shared top/links/steps resolver (ISSUE 13
+        # deduped the three per-command copies)
+        from kungfu_tpu.info.__main__ import _cluster_url
 
-        assert _links_url(["http://h:1/cluster/links"]) \
+        def links_url(argv):
+            return _cluster_url(argv, "/cluster/links")
+
+        assert links_url(["http://h:1/cluster/links"]) \
             == "http://h:1/cluster/links"
-        assert _links_url(["http://h:1"]) == "http://h:1/cluster/links"
-        assert _links_url(["http://h:1/cluster/health"]) \
+        assert links_url(["http://h:1"]) == "http://h:1/cluster/links"
+        assert links_url(["http://h:1/cluster/health"]) \
             == "http://h:1/cluster/links"
         monkeypatch.setenv("KF_CLUSTER_HEALTH_URL", "http://h:9/cluster/health")
-        assert _links_url([]) == "http://h:9/cluster/links"
+        assert links_url([]) == "http://h:9/cluster/links"
+        assert _cluster_url([], "/cluster/steps") == "http://h:9/cluster/steps"
         monkeypatch.delenv("KF_CLUSTER_HEALTH_URL")
-        assert _links_url([]) == ""
+        assert links_url([]) == ""
 
     def test_one_shot_over_http(self, linked3, capsys):
         from kungfu_tpu.info.__main__ import _cmd_links
