@@ -217,12 +217,13 @@ def reduce_scatter(
     """First-class reduce-scatter (ISSUE 11): reduce `x` across the
     cluster and return only this rank's owned 1/k shard — the RS half of
     the segmented ring walk, (k-1)/k·N bytes per peer, f32-exact. The
-    shard layout is ``plan.topology.owned_segment_bounds`` (contiguous
-    ``even_partition`` segments of the FLATTENED array), identical on
-    every peer without negotiation; ranks beyond the element count get
-    an empty shard (the n<k edge the segmented walk already handles).
-    ``all_gather(reduce_scatter(x))`` == ``all_reduce_array(x)`` bit for
-    bit."""
+    shard layout is the session's ``owned_bounds`` (contiguous
+    ``segment_bounds`` slices of the FLATTENED array under the current
+    ring plan — equal, or measured-topology re-planned, ISSUE 14),
+    identical on every peer without negotiation; ranks beyond the
+    element count get an empty shard (the n<k edge the segmented walk
+    already handles). ``all_gather(reduce_scatter(x))`` ==
+    ``all_reduce_array(x)`` bit for bit."""
     flat = np.ascontiguousarray(x).reshape(-1)
     out = np.empty_like(flat)
     w = Workspace(send=flat, recv=out, op=op, name=f"kungfu::user::rs:{name}")
@@ -239,17 +240,17 @@ def all_gather(shard: np.ndarray, name: str = "user") -> np.ndarray:
     wire codec like allreduce (bf16 on the wire for eligible f32
     payloads, each segment quantized once by its owner; see
     docs/collectives.md for the error model)."""
-    from kungfu_tpu.plan import topology as _topo
-
     sess = get_default_peer().current_session()
     flat = np.ascontiguousarray(shard).reshape(-1)
     # one int64 lane agrees the total element count (shard sizes differ
-    # by one across ranks under even_partition, so it is not derivable
+    # across ranks under the segment partition, so it is not derivable
     # locally); exact, never compressed
     total = int(all_reduce_array(
         np.array([flat.size], np.int64), ReduceOp.SUM, f"agsz:{name}"
     )[0])
-    b, e = _topo.owned_segment_bounds(total, sess.size, sess.rank)
+    # plan-aware: the owned-segment layout follows the session's current
+    # ring plan (naive, or measured-topology re-planned — ISSUE 14)
+    b, e = sess.owned_bounds(total)
     if flat.size != e - b:
         raise ValueError(
             f"all_gather shard has {flat.size} elements but rank "
@@ -403,6 +404,16 @@ def check_interference() -> bool:
     """Vote on interference; True if the cluster switched strategy (parity:
     check_interference, session/adaptiveStrategies.go:61-121)."""
     return get_default_peer().current_session().check_interference()
+
+
+def check_replan(want: bool = True, min_gain: float = 1.05) -> bool:
+    """One lockstep measured-topology re-plan round (ISSUE 14): vote,
+    exchange link rows, derive, digest-assert + adopt. Call on EVERY
+    peer at the same step boundary (the collective contract — see
+    ``policy.ReplanPolicy``, which drives this on an interval); a no-op
+    unless ``KF_CONFIG_REPLAN`` is on. True if a plan was adopted."""
+    sess = get_default_peer().current_session()
+    return sess.check_replan(want=want, min_gain=min_gain) is not None
 
 
 def active_strategy() -> "Optional[Strategy]":

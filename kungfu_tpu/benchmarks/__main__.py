@@ -158,7 +158,8 @@ def _simulated_backprop(grads, scratch, passes: int = 16) -> None:
             np.multiply(g, np.float32(1.0000001), out=s)
 
 
-def bench_host_async_ab(model: str, iters: int, warmup: int = 4) -> None:
+def bench_host_async_ab(model: str, iters: int, warmup: int = 4,
+                        passes: int = 16) -> None:
     """Paired same-process async-scheduler A/B (ISSUE 10): the SYNC leg
     runs the serial step loop — simulate every tensor's backward
     compute, then one step-end `group_all_reduce_arrays` — while the
@@ -195,7 +196,7 @@ def bench_host_async_ab(model: str, iters: int, warmup: int = 4) -> None:
     per = max(1, iters // 4)
 
     def run_sync(tag: str) -> None:
-        _simulated_backprop(grads, scratch)
+        _simulated_backprop(grads, scratch, passes)
         api.group_all_reduce_arrays(grads, name=tag, outs=outs)
 
     def run_async() -> None:
@@ -203,7 +204,7 @@ def bench_host_async_ab(model: str, iters: int, warmup: int = 4) -> None:
         # first); registration pins the launch order from round one, so
         # every peer walks identical bucket sequences regardless
         for i in reversed(range(n)):
-            _simulated_backprop(grads[i : i + 1], scratch[i : i + 1])
+            _simulated_backprop(grads[i : i + 1], scratch[i : i + 1], passes)
             api.group_all_reduce_async(
                 [grads[i]], name=f"b{i}", outs=[outs[i]]
             )
@@ -425,6 +426,171 @@ def bench_host_zero_ab(model: str, iters: int) -> None:
         f"(reduce-scatter + update + weight all-gather) overlapped with "
         f"caller compute"
     )
+
+
+def bench_host_replan_ab(model: str, iters: int, warmup: int = 4) -> None:
+    """Paired same-process measured-topology A/B (ISSUE 14), two legs.
+
+    **Ring order** — run under the harness's ``KF_SHAPE_LINKS`` shape
+    (e.g. one slowed edge): warm up on the NAIVE ring so the link table
+    measures the shaped edges, run one lockstep re-plan round
+    (``check_replan`` — vote, row exchange, pure derivation, digest-
+    asserted adoption: the exact production path), then alternate
+    measured-order and naive-order rounds within one process/session so
+    box drift cancels out of the ratio like every other HOST A/B.
+
+    **Weighted segments** — a compute-shaped peer (rank k-1 pays
+    ``_SLOW_FACTOR``× per element of its owned shard, standing in for a
+    busy/thermally-throttled host's optimizer update): alternate equal
+    segments with throughput-weighted ones derived from the MEASURED
+    per-peer update speed (exchanged over the ring, fed through
+    ``replan.weights_from_throughput`` — the same clamp/normalize the
+    vote path uses), reporting per-leg step medians and the ratio."""
+    from kungfu_tpu import api
+    from kungfu_tpu.base.ops import ReduceOp
+    from kungfu_tpu.base.workspace import Workspace
+    from kungfu_tpu.models.fake import fake_gradients
+    from kungfu_tpu.peer import get_default_peer
+    from kungfu_tpu.plan import replan as rp
+
+    grads = fake_gradients(model)
+    outs = [np.empty_like(g) for g in grads]
+    total_bytes = sum(g.nbytes for g in grads)
+    sess = get_default_peer().current_session()
+    k, rank = sess.size, sess.rank
+    api.run_barrier()
+    for i in range(warmup):
+        api.group_all_reduce_arrays(grads, name=f"wu:{i}", outs=outs)
+    # matrix probe sweep: the naive ring only measures its own k
+    # successor edges, so the planner would be blind to every edge it
+    # could move ONTO. A real training run accumulates that coverage
+    # from its broader traffic (broadcasts, gathers, elastic state
+    # sync, strategy changes); the bench stands that in with two
+    # rank-rotating 128 KiB broadcasts — every directed edge gets a
+    # bandwidth estimate (two sweeps: the first send on a fresh edge
+    # dials and is excluded as a sample), at ~k·(k-1)·128 KiB total
+    probe = np.ones((128 << 10) // 4, np.float32)  # 128 KiB
+    for sweep in range(2):
+        for root in range(k):
+            api.broadcast_array(
+                probe, root=root, name=f"replan:probe:{sweep}:{root}"
+            )
+    api.run_barrier()
+    # one production re-plan round: every peer votes yes (the bench IS
+    # the standing bottleneck signal), rows are exchanged, the plan is
+    # derived and digest-assert adopted
+    plan = sess.check_replan(want=True, min_gain=1.0)
+    if api.current_rank() == 0:
+        log.echo(
+            f"REPLAN {model}: "
+            + (
+                f"adopted {plan.describe()} (predicted gain "
+                f"{plan.gain:.2f}x)" if plan is not None
+                else "no plan adopted (uninformative matrix — is "
+                "KF_SHAPE_LINKS set and the payload above the bw gate?)"
+            )
+        )
+    legs: dict = {"naive": [], "measured": []}
+    rounds = 8
+    per = max(2, iters // 4)
+    for rnd in range(rounds):
+        mode = "naive" if rnd % 2 == 0 else "measured"
+        # lockstep toggle at a barrier, like --wire-ab's candidate flip:
+        # every peer swaps the same plan, no walk straddles it
+        sess._ring_plan = None if mode == "naive" else plan
+        api.run_barrier()
+        api.group_all_reduce_arrays(grads, name=f"settle:{rnd}", outs=outs)
+        for i in range(per):
+            t0 = time.perf_counter()
+            api.group_all_reduce_arrays(grads, name=f"ab:{rnd}:{i}", outs=outs)
+            legs[mode].append(
+                total_bytes / (time.perf_counter() - t0) / (1 << 30)
+            )
+    sess._ring_plan = None
+    api.run_barrier()
+    if api.current_rank() == 0:
+        meds = {m: float(np.median(s)) for m, s in legs.items()}
+        for m, s in legs.items():
+            log.echo(
+                f"RESULT: {float(np.mean(s)):.3f} "
+                f"+-{float(1.96 * np.std(s)):.3f} (GiB/s) "
+                f"median {meds[m]:.3f} [HOST-AB ring={m}, "
+                f"x{api.cluster_size()} workers, {model}, "
+                f"{len(s)} interleaved samples]"
+            )
+        if plan is not None and meds["naive"] > 0:
+            log.echo(
+                f"RESULT: measured-order / naive-order median speedup: "
+                f"{meds['measured'] / meds['naive']:.2f}x "
+                f"[interleaved paired, {model}, shaped]"
+            )
+
+    # ---- weighted segments vs equal, compute-shaped peer -------------
+    # BOTH legs run the measured ring ORDER (when one was adopted), so
+    # the shaped edge stays routed-around and the only variable is the
+    # segment sizing — the lever this leg measures
+    _SLOW_FACTOR = 4.0
+    _COST_PER_ELEM = 400e-9  # s/element of simulated optimizer update
+    n = 4 << 20  # 16 MiB f32
+    base_order = plan.order if plan is not None else tuple(range(k))
+    eq_plan = None if plan is None else rp.RingPlan(order=base_order)
+    cost = _COST_PER_ELEM * (_SLOW_FACTOR if rank == k - 1 else 1.0)
+    x = np.ones(n, np.float32)
+    out = np.empty_like(x)
+
+    def shard_step(tag: str) -> float:
+        t0 = time.perf_counter()
+        b, e = sess.reduce_scatter(Workspace(
+            send=x, recv=out, op=ReduceOp.SUM, name=f"{tag}:rs",
+        ))
+        time.sleep((e - b) * cost)  # the owned-shard update
+        full = np.zeros_like(x)
+        full[b:e] = out[b:e]
+        sess.all_gather_shards(full, f"{tag}:ag")
+        dt = time.perf_counter() - t0
+        api.run_barrier()
+        return dt
+
+    # measure each peer's update speed, exchange it, derive the weights
+    # every peer computes identically (pure function of shared input)
+    speeds = np.zeros(k, np.float32)
+    speeds[rank] = np.float32(1.0 / cost)
+    speeds_out = api.all_reduce_array(speeds, ReduceOp.SUM,
+                                      "replan:update-speeds")
+    rank_w = rp.weights_from_throughput(speeds_out.astype(np.float64))
+    wplan = eq_plan
+    if rank_w is not None:
+        wplan = rp.RingPlan(
+            order=base_order,
+            weights=rp.segment_weights(base_order, rank_w),
+        )
+    shard_step("wu-seg")  # warmup
+    seg_legs: dict = {"equal": [], "weighted": []}
+    for rnd in range(rounds):
+        mode = "equal" if rnd % 2 == 0 else "weighted"
+        sess._ring_plan = eq_plan if mode == "equal" else wplan
+        api.run_barrier()
+        for i in range(per):
+            seg_legs[mode].append(shard_step(f"seg:{rnd}:{i}"))
+    sess._ring_plan = None
+    api.run_barrier()
+    if api.current_rank() == 0:
+        meds = {m: float(np.median(s)) * 1e3 for m, s in seg_legs.items()}
+        for m, s in seg_legs.items():
+            log.echo(
+                f"RESULT: {float(np.mean(s)) * 1e3:.1f} "
+                f"+-{float(1.96 * np.std(s)) * 1e3:.1f} ms/step "
+                f"median {meds[m]:.1f} [HOST-AB segments={m}, "
+                f"x{api.cluster_size()} workers, rs+update+ag 16MiB, "
+                f"slow-rank x{_SLOW_FACTOR:.0f} compute, "
+                f"{len(s)} interleaved samples]"
+            )
+        if wplan is not None and meds["weighted"] > 0:
+            log.echo(
+                f"RESULT: equal / weighted median step-time ratio: "
+                f"{meds['equal'] / meds['weighted']:.2f}x "
+                f"[interleaved paired, compute-shaped peer]"
+            )
 
 
 def report_steps(model: str) -> None:
@@ -698,6 +864,24 @@ def main() -> None:
         "scheduler the plane instruments)",
     )
     p.add_argument(
+        "--passes", type=int, default=16,
+        help="HOST --async only: simulated-backprop passes per tensor "
+        "(compute:comm ratio of the A/B; 16 is a conservative LOW bound "
+        "for real backward passes — raise it to model matmul-heavy "
+        "layers, e.g. when a shaped link makes comm sleep-dominated)",
+    )
+    p.add_argument(
+        "--replan", action="store_true", dest="replan_ab",
+        help="HOST only: paired same-process measured-topology A/B "
+        "(ISSUE 14) — warm up on the naive ring under the harness's "
+        "KF_SHAPE_LINKS shape, adopt the measured re-plan through the "
+        "production vote/exchange/digest path, then alternate "
+        "measured-order vs naive-order rounds; plus the weighted-vs-"
+        "equal segments A/B under a compute-shaped peer (sets "
+        "KF_CONFIG_ALGO=segmented and KF_CONFIG_REPLAN=auto before the "
+        "session comes up)",
+    )
+    p.add_argument(
         "--async", action="store_true", dest="async_ab",
         help="HOST only: paired same-process async-scheduler A/B — "
         "alternate the serial step loop (compute all, then one step-end "
@@ -709,14 +893,16 @@ def main() -> None:
     args = p.parse_args()
     if args.method != "HOST" and (
         args.algo or args.wire or args.wire_ab or args.async_ab
-        or args.zero_ab or args.steps_report
+        or args.zero_ab or args.steps_report or args.replan_ab
     ):
         # the default method is XLA: silently measuring the wrong plane
         # is worse than an error
-        p.error("--algo/--wire/--wire-ab/--async/--zero/--steps only "
-                "apply to --method HOST")
-    if sum(1 for f in (args.wire_ab, args.async_ab, args.zero_ab) if f) > 1:
-        p.error("--wire-ab/--async/--zero are separate A/Bs — pick one")
+        p.error("--algo/--wire/--wire-ab/--async/--zero/--replan/--steps "
+                "only apply to --method HOST")
+    if sum(1 for f in (args.wire_ab, args.async_ab, args.zero_ab,
+                       args.replan_ab) if f) > 1:
+        p.error("--wire-ab/--async/--zero/--replan are separate A/Bs — "
+                "pick one")
     if args.method == "HOST":
         import os
 
@@ -729,6 +915,12 @@ def main() -> None:
         if args.zero_ab:
             os.environ["KF_CONFIG_ASYNC"] = "on"
             os.environ["KF_CONFIG_ZERO"] = "on"
+        if args.replan_ab:
+            # the measured plan reorders the SEGMENTED ring; every
+            # worker runs the same argv so the overrides stay
+            # cluster-agreed like --algo
+            os.environ["KF_CONFIG_ALGO"] = "segmented"
+            os.environ["KF_CONFIG_REPLAN"] = "auto"
         # wire-byte accounting rides the metrics gate; the bench wants it
         # on regardless so the A/B always reports bytes per peer
         from kungfu_tpu.telemetry import config as tconfig
@@ -743,9 +935,11 @@ def main() -> None:
     elif args.wire_ab:
         bench_host_wire_ab(args.model, args.iters)
     elif args.async_ab:
-        bench_host_async_ab(args.model, args.iters)
+        bench_host_async_ab(args.model, args.iters, passes=args.passes)
     elif args.zero_ab:
         bench_host_zero_ab(args.model, args.iters)
+    elif args.replan_ab:
+        bench_host_replan_ab(args.model, args.iters)
     else:
         bench_host(args.model, args.iters)
     if args.method == "HOST" and args.steps_report:

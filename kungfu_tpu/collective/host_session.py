@@ -61,6 +61,7 @@ from kungfu_tpu.collective.walks import (  # noqa: F401 - back-compat re-exports
     choose_chunk_bytes,
     _buf,
 )
+from kungfu_tpu.plan import replan as rp
 from kungfu_tpu.plan import topology as topo
 from kungfu_tpu.plan.graph import Graph
 from kungfu_tpu.plan.peer import PeerID, PeerList
@@ -164,6 +165,16 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         # scheduler itself is created lazily on first use (most sessions
         # — control planes, tests — never submit asynchronously)
         self.async_mode = knobs.get("KF_CONFIG_ASYNC")
+        # measured-topology re-planning knob (ISSUE 14): resolved once
+        # per epoch like the other engine modes; the ADOPTED plan (ring
+        # order + segment weights) starts naive and changes only through
+        # the lockstep check_replan/adopt_replan rounds below. Cluster-
+        # agreed — every peer must run the same re-plan rounds and the
+        # plan decides every segmented walk's bounds.
+        self.replan_mode = knobs.get("KF_CONFIG_REPLAN")
+        self._ring_plan: Optional[rp.RingPlan] = None
+        self._replan_seq = 0
+        self._replan_listeners: List[object] = []
         # ZeRO-1 sharded-update knob (ISSUE 11): resolved once per epoch
         # like the strategy/wire/async modes; consulted by the frontends
         # (ShardedUpdateSession, torch ZeroSGDOptimizer, api helpers) to
@@ -247,6 +258,37 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         # scores walks against; the sampler thins per-step spans
         self._links = tlink.get_table() if tlink.enabled() else None
         self._span_sampler = SpanSampler(tconfig.span_sample())
+        # graph-fallback audit dedup (ISSUE 14 satellite): while
+        # RING_SEGMENTED is active, non-allreduce graph consumers and
+        # sub-threshold payloads run the rank-0 binary-tree pair — by
+        # design, but previously silent. One audit event per session
+        # epoch names the fallback the first time it executes.
+        self._segmented_fallback_noted = False
+        self._in_fixed_walk = False
+        # active-ring observability (ISSUE 14): this peer's position in
+        # the current ring order and its successor, exported so the
+        # cluster aggregator can reconstruct (and `info links` render)
+        # the ACTIVE ring next to the measured matrix
+        if tconfig.metrics_enabled():
+            self._ring_pos_g = tmetrics.gauge(
+                "kungfu_topology_ring_position",
+                "This peer's position in the active segmented-ring order "
+                "(0-based; equals rank until a measured re-plan lands)",
+            )
+            self._ring_next_g = tmetrics.gauge(
+                "kungfu_topology_ring_next",
+                "The active ring successor of this peer (child per dst, "
+                "value 1) — the edge every segmented send crosses",
+                ("dst",),
+            )
+            self._replans_ctr = tmetrics.counter(
+                "kungfu_topology_replans_total",
+                "Measured-topology re-plans adopted by this peer's "
+                "session epochs",
+            )
+        else:
+            self._ring_pos_g = self._ring_next_g = self._replans_ctr = None
+        self._publish_ring_metrics()
         # collective-order sentinel (ISSUE 12): with the debug knob set,
         # protowatch wraps this instance's public entry points at bind
         # time. Unset = the module is never imported and the methods stay
@@ -356,8 +398,9 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         (ISSUE 11): after it, ``w.recv`` holds the fully reduced OWNED
         segment — whose (begin, end) element bounds are returned — and
         partially reduced garbage elsewhere. The layout is
-        ``topo.owned_segment_bounds(size, k, rank)``: contiguous
-        ``even_partition`` segments, identical on every peer without
+        :meth:`owned_bounds`: contiguous ``segment_bounds`` slices under
+        the CURRENT ring plan (equal, or throughput-weighted after a
+        measured re-plan — ISSUE 14), identical on every peer without
         negotiation. Always raw f32-exact ((k-1)/k·N bytes per peer);
         k == 1 (and empty payloads) degrade to ``forward()`` with the
         whole array owned. Runs the ring regardless of payload size —
@@ -365,7 +408,7 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         with self._collected("reduce_scatter", w.recv.nbytes):
             with stall_detect(f"reduce_scatter({w.name})"):
                 self._run_segmented(w, cancel=cancel, phase="rs")
-        return topo.owned_segment_bounds(w.recv.size, self.size, self.rank)
+        return self.owned_bounds(w.recv.size)
 
     def all_gather_shards(
         self,
@@ -508,6 +551,208 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
     def calc_stats(self) -> dict:
         """Per-strategy throughput summary (parity: CalcStats/LogStats)."""
         return self.adaptive.summary()
+
+    # ------------------------------------------------------------------
+    # measured-topology re-planning (ISSUE 14)
+    # ------------------------------------------------------------------
+
+    def ring_plan(self) -> Optional[rp.RingPlan]:
+        """The adopted measured-topology plan, or None for the naive
+        rank-order ring with equal segments."""
+        return self._ring_plan
+
+    def owned_bounds(self, count: int) -> Tuple[int, int]:
+        """(begin, end) bounds of the segment THIS rank owns fully
+        reduced after a reduce-scatter of ``count`` elements, under the
+        CURRENT ring plan — the single layout source the walk engine,
+        the ZeRO-1 shard views and the api helpers all read, so a plan
+        change re-shards every consumer through one function."""
+        plan = self._ring_plan
+        if plan is None:
+            return topo.owned_segment_bounds(count, self.size, self.rank)
+        return topo.owned_segment_bounds(
+            count, self.size, self.rank,
+            order=plan.order, weights=plan.weights,
+        )
+
+    def add_replan_listener(self, listener) -> None:
+        """Register an object with ``pre_replan() -> token`` /
+        ``post_replan(token)`` hooks, invoked around every plan adoption
+        (the ZeRO-1 session registers itself: pre exports exact state
+        under the OLD shard layout, post re-shards under the new)."""
+        self._replan_listeners.append(listener)
+
+    def _replan_name(self, kind: str) -> str:
+        """Round-stamped rendezvous name for the lockstep re-plan
+        rounds (KF700 discipline: version + per-epoch sequence — every
+        member runs these rounds in lockstep, so the stamp agrees
+        cluster-wide and repeats can never cross-consume lanes)."""
+        return f"kungfu::replan:{kind}:v{self.cluster_version}:{self._replan_seq}"
+
+    def measured_matrix(self) -> "np.ndarray":
+        """Exchange every peer's outgoing link-table row and return the
+        merged k×k bandwidth matrix (bytes/sec; 0 = no estimate),
+        identical bytes on every peer BY CONSTRUCTION: one gather to
+        rank 0 + one broadcast of the concatenation (``all_gather``),
+        so the plan derivation downstream is a pure function of shared
+        input — the version-skew a scraped /cluster/links snapshot
+        would reintroduce cannot exist here. Collective: call in
+        lockstep on every peer."""
+        k = self.size
+        row = np.zeros(k, np.float32)
+        if self._links is not None:
+            for j, pid in enumerate(self.peers):
+                if j == self.rank:
+                    continue
+                bw = self._links.bandwidth(pid)
+                if bw is not None and bw > 0:
+                    row[j] = np.float32(bw)
+        out = np.zeros(k * k, np.float32)
+        self.all_gather(Workspace(
+            send=row, recv=out, op=ReduceOp.SUM,
+            name=self._replan_name("mx"),
+        ))
+        return out.reshape(k, k).astype(np.float64)
+
+    def check_replan(
+        self, want: bool = True, min_gain: float = 1.05, tag: str = ""
+    ) -> Optional[rp.RingPlan]:
+        """One lockstep re-plan round (ISSUE 14): call on EVERY peer at
+        the same step boundary (the :class:`~kungfu_tpu.policy
+        .ReplanPolicy` gates on the step counter). Mirrors the
+        interference vote's shape:
+
+        1. majority vote over each peer's local ``want`` (its signal
+           window: a persistent ``links/slowest_edge`` or
+           ``step/critical_edge``);
+        2. on a majority, exchange the measured link rows
+           (:meth:`measured_matrix`) — every peer now holds identical
+           matrix bytes;
+        3. derive the plan (``plan.replan.derive_plan`` — pure function
+           of the matrix, so every peer derives the identical plan) and
+           adopt it via :meth:`adopt_replan` when the predicted gain
+           clears ``min_gain``.
+
+        Returns the adopted plan, or None (no majority / no measurable
+        win / mode off). ``KF_CONFIG_REPLAN`` is consensus-checked at
+        session start, so either every peer runs these rounds or none
+        does — a half-configured fleet fails fast at epoch start, not
+        here."""
+        if (
+            self.replan_mode == "off"
+            or self.size < 2
+            or self._tree_override
+        ):
+            return None
+        votes_in = np.array([1 if want else 0], np.int32)
+        votes_out = np.zeros(1, np.int32)
+        self._fixed_allreduce(Workspace(
+            votes_in, votes_out, ReduceOp.SUM,
+            self._replan_name("vote") + tag,
+        ))
+        if int(votes_out[0]) * 2 <= self.size:
+            self._replan_seq += 1
+            return None
+        matrix = self.measured_matrix()
+        plan = rp.derive_plan(
+            matrix, mode=self.replan_mode, current=self._ring_plan,
+        )
+        if plan is None or not self._replan_worthwhile(plan, min_gain):
+            # nothing derivable, or the predicted win doesn't clear the
+            # bar — seq still advances (every peer took the same branch:
+            # the decision is a pure function of the shared matrix)
+            self._replan_seq += 1
+            return None
+        self.adopt_replan(plan)
+        return plan
+
+    def _replan_worthwhile(self, plan: rp.RingPlan, min_gain: float) -> bool:
+        """Churn gate, a pure function of (current plan, derived plan):
+        a REORDER must clear ``min_gain`` (estimates drift every round —
+        re-pairing the ring on noise costs a ZeRO re-shard each time,
+        live-drive finding); an order-preserving weight refinement must
+        move some segment weight by ≥10% relative."""
+        cur = self._ring_plan
+        if cur is None or plan.order != cur.order:
+            return plan.gain >= min_gain
+        if plan.weights is None or cur.weights is None:
+            return True  # weights appearing/disappearing is material
+        return any(
+            abs(n - o) > 0.1 * max(o, 1e-12)
+            for n, o in zip(plan.weights, cur.weights)
+        )
+
+    def adopt_replan(self, plan: Optional[rp.RingPlan]) -> None:
+        """Install ``plan`` (None = back to the naive ring) as the
+        active topology, cluster-safely; call in lockstep on every peer
+        at a step boundary (no walk in flight).
+
+        The plan digest is asserted on the knob-INDEPENDENT star walk
+        first (KF700/701 discipline): a peer whose matrix-fed derivation
+        diverged gets a named RuntimeError here — never a rendezvous
+        hang inside a later walk whose segment bounds silently differ.
+        Registered listeners bracket the swap (``pre_replan`` runs under
+        the OLD plan — the ZeRO-1 session exports exact state there —
+        and ``post_replan`` re-shards under the new)."""
+        seq = self._replan_seq
+        self._replan_seq += 1
+        if not self._bytes_agree(
+            rp.plan_digest(plan),
+            f":replan:adopt:v{self.cluster_version}:{seq}",
+            self._fixed_allreduce,
+        ):
+            raise RuntimeError(
+                "measured-topology re-plan diverged across peers: the "
+                "ring plan must be a pure function of the exchanged "
+                "link matrix, but this peer derived "
+                f"{plan.describe() if plan is not None else 'naive'} "
+                f"(digest {rp.plan_digest(plan).hex()}) and at least one "
+                "peer derived something else — refusing to install "
+                "mismatched segment bounds (walks would deadlock or "
+                "corrupt); this is a determinism bug, not a transient"
+            )
+        tokens = [
+            (listener, listener.pre_replan())
+            for listener in self._replan_listeners
+        ]
+        old = self._ring_plan
+        self._ring_plan = plan
+        for listener, token in tokens:
+            listener.post_replan(token)
+        self._publish_ring_metrics()
+        if self._replans_ctr is not None:
+            self._replans_ctr.inc()
+        from kungfu_tpu.telemetry import audit as _audit
+
+        _audit.record_event(
+            "topology_replanned",
+            peer=str(self.self_id),
+            trigger="replan_vote",
+            old_order=list(old.order) if old is not None else list(range(self.size)),
+            new_order=(
+                list(plan.order) if plan is not None
+                else list(range(self.size))
+            ),
+            weighted=bool(plan is not None and plan.weights is not None),
+            predicted_gain=plan.gain if plan is not None else 1.0,
+        )
+
+    def _publish_ring_metrics(self) -> None:
+        """Refresh the active-ring gauges (position + successor edge)
+        from the current plan; children are rebuilt so a re-plan never
+        leaves the OLD successor edge frozen in the exposition."""
+        if self._ring_pos_g is None:
+            return
+        order = (
+            self._ring_plan.order if self._ring_plan is not None
+            else tuple(range(self.size))
+        )
+        pos = order.index(self.rank)
+        succ = self.peers[order[(pos + 1) % self.size]] if self.size > 1 else None
+        self._ring_pos_g.set(pos)
+        self._ring_next_g.clear_children()
+        if succ is not None:
+            self._ring_next_g.labels(str(succ)).set(1)
 
     def cross_all_reduce(self, w: Workspace) -> None:
         """AllReduce across host masters only (hierarchical path). While
@@ -658,15 +903,27 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             ("KF_CONFIG_WIRE_MIN_BYTES", str(self.WIRE_MIN_BYTES)),
             ("KF_CONFIG_ASYNC", self.async_mode),
             ("KF_CONFIG_ZERO", self.zero_mode),
+            ("KF_CONFIG_REPLAN", self.replan_mode),
         ]
 
     def _fixed_allreduce(self, w: Workspace) -> None:
         """Allreduce over a rank-0 star, unchunked and uncompressed — a
         walk whose rendezvous names and message sizes depend on NOTHING
         the knobs control, so it completes even across knob-divergent
-        peers (tiny payloads; latency is 2 serialized hops)."""
-        bcast, red = self._root_star_graphs(0)
-        self._run_graphs(w, [red, bcast])
+        peers (tiny payloads; latency is 2 serialized hops).
+
+        Marked as a DELIBERATE graph walk: the knob-consensus and
+        re-plan rounds choose the star by design, so they must not
+        trip the `segmented_fallback` audit meant for payloads that
+        FELL BACK from the segmented engine (review finding: every
+        segmented session fired the event on its startup consensus
+        walk, before any user collective could)."""
+        self._in_fixed_walk = True
+        try:
+            bcast, red = self._root_star_graphs(0)
+            self._run_graphs(w, [red, bcast])
+        finally:
+            self._in_fixed_walk = False
 
     def check_knob_consensus(self) -> None:
         """Fail fast on engine-knob divergence (satellite of ISSUE 5).

@@ -169,10 +169,17 @@ _GENERATORS = {
     Strategy.BINARY_TREE_STAR: _binary_tree_star,
     Strategy.MULTI_BINARY_TREE_STAR: _multi_binary_tree_star,
     # RING_SEGMENTED's allreduce runs the engine's dedicated segmented
-    # walk (host_session._run_segmented), not these graphs. The pair here
-    # backs the RESIDUAL graph ops (reduce/broadcast/gather, and tiny
-    # payloads below the segmentation threshold): a rank-0 binary tree —
-    # latency-optimal for the small control collectives that hit it.
+    # walk (walks._run_segmented), not these graphs. The pair here backs
+    # the RESIDUAL graph consumers — reduce/broadcast/gather and
+    # allreduce payloads below KF_CONFIG_SEGMENT_MIN_BYTES — with a
+    # rank-0 binary tree: latency-optimal for the small control
+    # collectives that hit it. This fallback is BY DESIGN but not
+    # silent: the first graph walk per session epoch under an active
+    # RING_SEGMENTED emits a `segmented_fallback` audit event, and its
+    # wire bytes are labeled BINARY_TREE (WalkEngine._walk_label — PR
+    # 4's counter-purity rule: the RING_SEGMENTED series is what the
+    # 2·(k-1)/k·N optimality assertion reads, so fallback traffic must
+    # never pollute it).
     Strategy.RING_SEGMENTED: _binary_tree,
 }
 

@@ -201,13 +201,29 @@ class WalkEngine:
     def _walk_label(self) -> str:
         """Strategy label for graph-walk wire accounting. Labels the
         graphs that actually EXECUTED: when RING_SEGMENTED is active but
-        a payload fell below SEGMENT_MIN_BYTES, the walk ran the binary-
-        tree fallback graphs and must not pollute the RING_SEGMENTED
-        series (it is the one the optimality assertion reads)."""
+        a payload fell below SEGMENT_MIN_BYTES (or a non-allreduce graph
+        consumer — reduce/broadcast/gather — walked the strategy table's
+        fallback pair), the walk ran the binary-tree fallback graphs and
+        must not pollute the RING_SEGMENTED series (it is the one the
+        optimality assertion reads). The first such fallback per session
+        epoch is audited (`segmented_fallback`) so the by-design
+        tree-under-segmented path is visible, not silent (ISSUE 14
+        satellite; PR 4's counter-purity rule)."""
         if self._tree_override:
             return "SET_TREE"
         active = self._candidates[self.adaptive.active][0]
         if active == Strategy.RING_SEGMENTED:
+            if not self._segmented_fallback_noted and not self._in_fixed_walk:
+                self._segmented_fallback_noted = True
+                from kungfu_tpu.telemetry import audit as _audit
+
+                _audit.record_event(
+                    "segmented_fallback",
+                    peer=str(self.self_id),
+                    collective=self._wire_kind,
+                    wire_label=Strategy.BINARY_TREE.name,
+                    threshold_bytes=self.SEGMENT_MIN_BYTES,
+                )
             return Strategy.BINARY_TREE.name
         return active.name
 
@@ -305,7 +321,18 @@ class WalkEngine:
         if w.is_empty:
             w.forward()
             return None
-        members = list(range(self.size)) if ranks is None else list(ranks)
+        # measured-topology plan (ISSUE 14): the GLOBAL ring follows the
+        # adopted plan's order and segment weights; subset rings
+        # (hierarchical cross-host mode) stay naive — the plan indexes
+        # the full rank space. Read once per walk: adoption happens in
+        # lockstep at step boundaries, so no walk straddles a flip.
+        plan = self._ring_plan if ranks is None else None
+        if plan is not None:
+            members = list(plan.order)
+            weights = plan.weights
+        else:
+            members = list(range(self.size)) if ranks is None else list(ranks)
+            weights = None
         k = len(members)
         if self.rank not in members or k == 1:
             w.forward()
@@ -316,7 +343,7 @@ class WalkEngine:
         # chunk jobs hop to pool threads)
         steptrace_sink = steptrace.current_sink()
         sched = topo.gen_segmented_schedule(members, members.index(self.rank))
-        bounds = even_partition(w.recv.size, k)
+        bounds = topo.segment_bounds(w.recv.size, k, weights)
         w.forward()  # seed the accumulator with own contribution
         acc = w.recv
         send_peer = self.peers[sched.send_peer]

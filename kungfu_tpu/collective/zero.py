@@ -171,7 +171,7 @@ class _Bucket:
     )
 
     def __init__(self, index: int, names, params, opt: ShardedSGD,
-                 k: int, rank: int):
+                 bounds: Tuple[int, int]):
         self.index = index
         self.names = list(names)
         self.params = list(params)
@@ -190,7 +190,10 @@ class _Bucket:
         # next round's shard write on the same bucket
         self.settled = threading.Event()
         self.settled.set()
-        self.ob, self.oe = topo.owned_segment_bounds(self.total, k, rank)
+        # the owned-shard bounds under the session's CURRENT ring plan
+        # (HostSession.owned_bounds — the single layout source); a
+        # measured re-plan re-slices them through reshard_bounds
+        self.ob, self.oe = bounds
         # f32 master of the owned shard: the update's source of truth.
         # The mirror W may be bf16-quantized by the weight all-gather;
         # the master integrates sub-ULP updates the mirror would lose.
@@ -202,6 +205,15 @@ class _Bucket:
         for arr in self.state.values():
             n += arr.nbytes
         return n
+
+    def reshard_bounds(self, opt: ShardedSGD, bounds: Tuple[int, int]) -> None:
+        """Re-slice this bucket's shard to new owned bounds (a measured
+        re-plan moved the segment layout). The caller restores master/
+        state contents from an exported full-state blob immediately
+        after — the freshly sized arrays here are pure allocation."""
+        self.ob, self.oe = bounds
+        self.master = np.empty(self.oe - self.ob, np.float32)
+        self.state = opt.init(self.oe - self.ob)
 
 
 class ShardedUpdateSession:
@@ -266,7 +278,13 @@ class ShardedUpdateSession:
         for idxs in bucket_layout([v.size for v in views],
                                   session.GROUP_BUCKET_BYTES):
             self._add_bucket([self._member_names[i] for i in idxs],
-                             [views[i] for i in idxs], k)
+                             [views[i] for i in idxs])
+        # measured-topology re-planning (ISSUE 14): a plan adoption
+        # moves the owned-segment layout, so this session must re-shard
+        # its masters/state exactly — pre_replan exports the full state
+        # under the OLD layout, post_replan re-slices under the new
+        if hasattr(session, "add_replan_listener"):
+            session.add_replan_listener(self)
         self._sync_round = 0
         self._export_seq = 0
         self._lock = threading.Lock()
@@ -289,12 +307,20 @@ class ShardedUpdateSession:
             self._state_gauge = None
             self._update_ctr = None
 
-    def _add_bucket(self, names, params, k) -> None:
+    def _add_bucket(self, names, params) -> None:
+        total = int(sum(p.size for p in params))
         b = _Bucket(len(self._buckets), names, params, self.opt,
-                    k, self.sess.rank)
+                    self._owned_bounds(total))
         for j, n in enumerate(names):
             self._member_bucket[n] = (b.index, j)
         self._buckets.append(b)
+
+    def _owned_bounds(self, total: int) -> Tuple[int, int]:
+        """The session's plan-aware owned bounds (falls back to the
+        naive layout for bare/mock sessions without the accessor)."""
+        if hasattr(self.sess, "owned_bounds"):
+            return self.sess.owned_bounds(total)
+        return topo.owned_segment_bounds(total, self.sess.size, self.sess.rank)
 
     # ------------------------------------------------------------------
     # introspection
@@ -598,6 +624,32 @@ class ShardedUpdateSession:
                 leaves.append(full)
         return pack_leaves(leaves)
 
+    # ------------------------------------------------------------------
+    # measured-topology re-plan hooks (ISSUE 14)
+    # ------------------------------------------------------------------
+
+    def pre_replan(self) -> bytes:
+        """Replan-listener hook, called by ``HostSession.adopt_replan``
+        BEFORE the plan swap (in lockstep on every peer, at a step
+        boundary): quiesce in-flight weight all-gathers, then export the
+        full exact state under the OLD shard layout. The returned blob
+        feeds :meth:`post_replan`."""
+        if self.sess._scheduler is not None:
+            self.wait_params()
+        return self.export_state()
+
+    def post_replan(self, blob: bytes) -> None:
+        """Replan-listener hook, called AFTER the plan swap: re-slice
+        every bucket's shard to the session's NEW owned bounds and
+        restore masters/state from the pre-swap export — bit-exact
+        re-sharding, the same contract as an elastic resize
+        (``export_state``/``restore_state``), just without changing k."""
+        for b in self._buckets:
+            b.reshard_bounds(self.opt, self._owned_bounds(b.total))
+        self._restore(blob)
+        if self._state_gauge is not None:
+            self._state_gauge.set(self.state_bytes())
+
     def _restore(self, blob: bytes) -> None:
         per_bucket = 1 + len(self.opt.state_names())
         leaves = unpack_leaves(blob, per_bucket * len(self._buckets))
@@ -622,10 +674,11 @@ class ShardedUpdateSession:
                     b.master = full[b.ob:b.oe].copy()
                     for j, p in enumerate(b.params):
                         off = b.offsets[j]
-                        # kfcheck: disable=KF703 — constructor-time
-                        # restore: no walk is in flight yet, so no abort
-                        # scope exists; the params are the caller's to
-                        # initialize before the first step
+                        # kfcheck: disable=KF703 — quiesced restore: runs
+                        # at construction or inside a lockstep re-plan
+                        # adoption (post_replan), both with no walk in
+                        # flight, so no abort scope exists; the params
+                        # are ours to (re)initialize before the next step
                         np.copyto(p, b.W[off:off + b.sizes[j]])
                 else:
                     np.copyto(b.state[name], full[b.ob:b.oe])

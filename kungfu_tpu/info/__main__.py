@@ -319,7 +319,78 @@ def render_links(doc: dict) -> str:
         )
     legend = [f"  [{i}] {p}" for p, i in sorted(idx.items(), key=lambda kv: kv[1])]
     notes = "cells: MiB/s, '-' no estimate yet, '!' under half the median"
-    return "\n".join([summary] + lines + [notes, "peers:"] + legend)
+    return "\n".join(
+        [summary] + lines + _render_ring_lines(doc, peers, idx)
+        + [notes, "peers:"] + legend
+    )
+
+
+def _same_cycle(a: list, b: list) -> bool:
+    """Directed-cycle equality up to rotation: rings are
+    rotation-invariant, and the CLI's derivation starts from the
+    first LISTED peer while the engine pins rank 0 — the two can agree
+    on the cycle yet disagree on where to start printing it."""
+    if len(a) != len(b) or not a:
+        return False
+    if set(a) != set(b):
+        return False
+    i = b.index(a[0])
+    return list(a) == list(b[i:]) + list(b[:i])
+
+
+def _render_ring_lines(doc: dict, peers: list, idx: dict) -> list:
+    """Ring view under the matrix (ISSUE 14): the ACTIVE ring order the
+    workers export (starred when it differs from rank order — a measured
+    re-plan landed) and the order the optimizer would derive from the
+    rendered matrix — so an operator sees a PENDING re-plan before the
+    vote lands. Derivation runs the same pure `plan.replan.ring_order`
+    the engine votes on, fed by the same matrix this frame renders;
+    ADVISORY only: the CLI indexes peers in listing order (it cannot
+    know ranks), so agreement with the active ring is judged as a
+    directed CYCLE (rotation-invariant), and a greedy construction from
+    a different start can still legitimately differ on near-tie
+    matrices."""
+    lines = []
+
+    def fmt(order_labels) -> str:
+        return "→".join(f"[{idx[p]}]" for p in order_labels if p in idx)
+
+    ring = doc.get("ring") or {}
+    active = ring.get("order")
+    if active:
+        star = " ★ re-planned (differs from rank order)" if (
+            not _same_cycle(list(active), list(peers))
+        ) else " (rank order)"
+        lines.append(f"active ring:    {fmt(active)}{star}")
+    bw = [
+        [
+            (doc.get("edges", {}).get(src, {}).get(dst, {}) or {}).get("bw")
+            or 0.0
+            for dst in peers
+        ]
+        for src in peers
+    ]
+    try:
+        import numpy as _np
+
+        from kungfu_tpu.plan import replan as _replan
+
+        order = _replan.ring_order(_np.asarray(bw, float))
+    except Exception as e:  # noqa: BLE001 - a render must survive a bad matrix
+        lines.append(f"predicted ring: unavailable ({e})")
+        return lines
+    predicted = [peers[i] for i in order]
+    if active and _same_cycle(list(predicted), list(active)):
+        # display the agreeing cycle rotated to match the active line
+        i = predicted.index(active[0])
+        predicted = predicted[i:] + predicted[:i]
+    mark = ""
+    if active and list(predicted) != list(active):
+        mark = " ← pending re-plan (differs from the active ring)"
+    elif not active and not _same_cycle(list(predicted), list(peers)):
+        mark = " ← differs from rank order"
+    lines.append(f"predicted ring: {fmt(predicted)}{mark}")
+    return lines
 
 
 def _cmd_links(argv) -> int:

@@ -362,6 +362,19 @@ _knob("KF_CONFIG_ZERO", "",
       "at every session epoch.",
       section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
       default_doc="off")
+_knob("KF_CONFIG_REPLAN", "",
+      _choice("KF_CONFIG_REPLAN",
+              ("off", "ring", "ring+segments", "auto"), empty_as="off"),
+      "Measured-topology re-planning of the segmented ring: `ring` lets "
+      "the vote-driven re-plan reorder ring neighbours from the measured "
+      "link matrix, `ring+segments` additionally sizes segments by "
+      "measured per-peer throughput, `auto` == `ring+segments`, `off` "
+      "keeps the naive rank-order ring. Cluster-agreed: every peer must "
+      "run the same lockstep re-plan rounds (and the adopted plan "
+      "decides segment bounds), so it is checked by "
+      "`check_knob_consensus` at every session epoch.",
+      section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
+      default_doc="off")
 _knob("KF_CONFIG_ASYNC_QUEUE", "2", _int,
       "Async scheduler launch-queue depth: how many packed buckets may "
       "sit between the pack and walk stages (bounds live pooled staging "
@@ -407,13 +420,24 @@ _knob("KF_DEBUG_PROTOCOL", "", _bool,
       "`kungfu_debug_protocol_*` metrics — before the rendezvous hang, "
       "not after. Off = protowatch never imported, hot path untouched.",
       section=_SEC_DEBUG, kind="bool")
+_knob("KF_SHAPE_LINKS", "", _str,
+      "Shaped-link harness (ISSUE 14): per-edge latency/bandwidth/"
+      "jitter shaping of transport sends, applied inside the timed "
+      "send window so the link table, walk profiler and step plane all "
+      "observe the shape. Format: `;`-separated entries "
+      "`[src>]dst=key:value[,key:value...]` with keys `lat:<ms>` "
+      "(per-message latency), `bw:<rate>` (token-bucket pacing; rate "
+      "accepts KiB/MiB/GiB[ps] suffixes, plain numbers are bytes/sec) "
+      "and `jitter:<ms>` (deterministic pseudo-random 0..jitter extra). "
+      "`dst` is a `host:port` peer spec or `*`; `src` (optional) "
+      "restricts the entry to the sender with that peer spec. "
+      "Local-only test/bench harness, never set in production.",
+      section=_SEC_DEBUG, kind="str")
 _knob("KF_TEST_SLOW_EDGE", "", _str,
-      "Test-only fault injection for the step plane's e2e: delay every "
-      "transport send over one directed edge. Format `[src>]dst=ms` "
-      "with src/dst as `host:port` peer specs — `38001>…:38002=40` "
-      "adds 40 ms to each send from the worker whose KF_SELF_SPEC is "
-      "src toward dst (src omitted: every worker sending to dst). "
-      "Local-only, never set in production.",
+      "DEPRECATED alias of `KF_SHAPE_LINKS`: `[src>]dst=ms` parses as "
+      "`[src>]dst=lat:ms` (with a deprecation warning) so stale envs "
+      "keep injecting. Use `KF_SHAPE_LINKS`. Local-only, never set in "
+      "production.",
       section=_SEC_DEBUG, kind="str")
 _knob("KF_DEBUG_PROTOCOL_WINDOW", "512", _int,
       "Collective-order sentinel: max recorded entries per check window. "

@@ -34,6 +34,10 @@ FAKE_MODELS: Dict[str, List[int]] = {
         25088 * 4096, 4096 * 4096, 4096 * 1000,
     ],
     "bert": [1024 * 1024] * 24 * 6 + [30522 * 1024, 512 * 1024],
+    # 4 MiB in one tensor: sized for shaped-link benches (ISSUE 14) —
+    # big enough that per-segment sends clear the link-plane bw gate at
+    # k<=32, small enough that a 16 MiB/s shaped edge stays affordable
+    "mlp-4mib": [1 << 20],
 }
 
 

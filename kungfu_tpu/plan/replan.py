@@ -1,0 +1,327 @@
+"""Measured-topology re-planning (ROADMAP item 2 / ISSUE 14 tentpole).
+
+PR 6 gave every worker a measured k×k bandwidth/latency matrix and PR 11
+named the blocking (peer, edge) per training step; this module closes
+the loop: pure functions that turn the MEASURED matrix into a better
+ring plan — the source paper's "adapt the communication strategy to the
+monitored network" applied to the segmented ring engine
+(arXiv:1909.09756 motivates topology-matched collective shapes).
+
+Everything here is a **pure, deterministic function of its inputs**:
+every peer that feeds the same matrix in derives the byte-identical
+:class:`RingPlan` out. That is the cluster-safety contract — the plan
+digest is asserted on the knob-independent consensus walk at adoption
+(``HostSession.adopt_replan``), so a peer whose derivation diverged
+gets a named error, never a rendezvous hang.
+
+Two levers:
+
+- :func:`ring_order` — a ring permutation placing each peer next to its
+  fastest measured links: greedy max-min-edge construction refined by
+  2-opt (segment reversal, asymmetric-aware: candidate orders are
+  re-scored, not mirrored). The objective is lexicographic
+  ``(min edge bandwidth, total edge bandwidth)`` — a ring walk
+  serializes on its slowest edge, so the minimum edge is what step
+  wall-clock sees. Rank 0 stays first (rings are rotation-invariant;
+  pinning the start keeps plans canonical and diffs readable).
+- :func:`weighted_partition` — contiguous throughput-proportional
+  segments. The owned segment sizes the per-peer work that does NOT
+  rotate around the ring: the ZeRO-1 shard update (optimizer FLOPs +
+  state ∝ owned size), the all-gather seed encode, and the one segment
+  a peer never sends. A slow peer gets a smaller owned segment, so the
+  update tail stops straggling on it.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import hashlib
+import json
+from typing import List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# weights are clamped to [mean/CLAMP, mean*CLAMP] before normalizing: a
+# wildly mis-measured peer must shift segment sizes, not collapse its
+# segment to zero (an empty owned segment would drop that peer's update
+# work entirely and concentrate it elsewhere)
+WEIGHT_CLAMP = 4.0
+# 2-opt refinement passes are capped for bounded runtime at k=64 (the
+# scan is deterministic first-improvement, so the cap never introduces
+# cross-peer divergence — every peer stops at the same pass)
+MAX_2OPT_PASSES = 64
+
+
+def weighted_partition(
+    count: int, weights: Sequence[float]
+) -> List[Tuple[int, int]]:
+    """Split [0, count) into ``len(weights)`` contiguous intervals with
+    sizes proportional to ``weights``.
+
+    Boundaries are cumulative-rounded (``floor(count·cum + 0.5)``), which
+    gives three properties the shard layout depends on (property-tested):
+
+    - **contiguous + lossless**: intervals tile [0, count) exactly;
+    - **monotone**: growing one weight (others fixed) never shrinks its
+      interval — boundaries left of it stay put, boundaries right of it
+      only move right;
+    - **degenerate-safe**: an all-zero weight vector falls back to
+      :func:`~kungfu_tpu.base.workspace.even_partition`; ``count < k``
+      produces empty intervals exactly like the even split.
+
+    Negative weights are a caller bug and raise."""
+    k = len(weights)
+    if k <= 0:
+        raise ValueError("weighted_partition needs at least one weight")
+    w = [float(x) for x in weights]
+    if any(x < 0 for x in w):
+        raise ValueError(f"weights must be non-negative, got {w}")
+    total = sum(w)
+    if total <= 0.0:
+        from kungfu_tpu.base.workspace import even_partition
+
+        return even_partition(count, k)
+    bounds: List[Tuple[int, int]] = []
+    cum = 0.0
+    prev = 0
+    for i in range(k):
+        cum += w[i]
+        end = count if i == k - 1 else min(count, int(count * (cum / total) + 0.5))
+        end = max(end, prev)
+        bounds.append((prev, end))
+        prev = end
+    return bounds
+
+
+@dataclasses.dataclass(frozen=True)
+class RingPlan:
+    """A measured-topology plan for the global segmented ring.
+
+    ``order`` is the ranks in ring order (a permutation of
+    ``range(k)``, ``order[0] == 0``); ``weights`` — when present — are
+    per-SEGMENT weights (segment ``s`` is owned by the member at ring
+    position ``(s - 1) % k``, i.e. rank ``order[(s - 1) % k]``), summing
+    to ~1. ``gain`` is the optimizer's predicted step-throughput ratio
+    vs the plan it replaces (min-ring-edge bandwidth ratio — the edge a
+    ring walk serializes on).
+
+    Byte serialization is canonical (sorted keys, fixed float rounding
+    upstream), so equality of derivations is equality of bytes — what
+    the adoption digest asserts."""
+
+    order: Tuple[int, ...]
+    weights: Optional[Tuple[float, ...]] = None
+    gain: float = 1.0
+
+    def __post_init__(self):
+        k = len(self.order)
+        if sorted(self.order) != list(range(k)):
+            raise ValueError(f"order must be a permutation of 0..{k - 1}: "
+                             f"{self.order}")
+        if self.weights is not None and len(self.weights) != k:
+            raise ValueError(
+                f"{len(self.weights)} weights for a ring of {k}"
+            )
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(
+            {
+                "order": list(self.order),
+                "weights": (
+                    None if self.weights is None else list(self.weights)
+                ),
+                "gain": round(float(self.gain), 6),
+            },
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+
+    def digest(self) -> bytes:
+        return hashlib.blake2b(self.to_bytes(), digest_size=16).digest()
+
+    def describe(self) -> str:
+        arrow = "→".join(str(r) for r in self.order)
+        w = "" if self.weights is None else " (weighted segments)"
+        return f"{arrow}{w}"
+
+
+def plan_digest(plan: Optional[RingPlan]) -> bytes:
+    """Digest of a possibly-absent plan (None = the naive rank-order
+    ring with equal segments) — the bytes the adoption consensus walks."""
+    return plan.digest() if plan is not None else b"naive-ring"
+
+
+def _fill_unknown(bw: np.ndarray) -> Optional[np.ndarray]:
+    """Score matrix with unknown (<= 0 / non-finite) edges set to the
+    median known estimate — unknown is neutral, not slow. None when
+    nothing is estimated at all."""
+    m = np.array(bw, np.float64, copy=True)
+    k = m.shape[0]
+    mask = np.isfinite(m) & (m > 0)
+    np.fill_diagonal(mask, False)
+    known = m[mask]
+    if known.size == 0:
+        return None
+    fill = float(np.median(known))
+    m[~mask] = fill
+    np.fill_diagonal(m, 0.0)
+    return m
+
+
+def _ring_edges(order: Sequence[int]) -> List[Tuple[int, int]]:
+    k = len(order)
+    return [(order[i], order[(i + 1) % k]) for i in range(k)]
+
+
+def _objective(score: np.ndarray, order: Sequence[int]) -> Tuple[float, float]:
+    """(min edge, sum of edges) — lexicographic, maximized."""
+    edges = _ring_edges(order)
+    vals = [float(score[i, j]) for i, j in edges]
+    return (min(vals), sum(vals))
+
+
+def ring_order(bw: np.ndarray) -> Tuple[int, ...]:
+    """Deterministic ring permutation over ``range(k)`` maximizing the
+    lexicographic ``(min edge bw, total edge bw)`` objective: greedy
+    max-min-edge construction (append the unvisited peer with the
+    fastest measured link from the current tail; ties take the lowest
+    rank) followed by 2-opt refinement (first-improvement segment
+    reversal with rank 0 pinned first; candidate orders are re-scored
+    against the DIRECTED matrix, so asymmetric links are handled).
+
+    Pure function of the matrix: every peer derives the identical
+    permutation from the same bytes. A matrix with no estimates, a
+    uniform matrix, or k <= 2 returns rank order (re-planning is a
+    no-op without information)."""
+    k = int(np.asarray(bw).shape[0])
+    identity = tuple(range(k))
+    if k <= 2:
+        return identity
+    score = _fill_unknown(np.asarray(bw))
+    if score is None:
+        return identity
+    off_diag = score[~np.eye(k, dtype=bool)]
+    if off_diag.size and np.allclose(off_diag, off_diag[0], rtol=1e-6):
+        return identity  # uniform: nothing to optimize, keep rank order
+    # greedy max-min-edge construction
+    order = [0]
+    remaining = set(range(1, k))
+    while remaining:
+        last = order[-1]
+        best = max(
+            sorted(remaining), key=lambda c: (score[last, c], -c)
+        )
+        order.append(best)
+        remaining.discard(best)
+    # 2-opt refinement (rank 0 pinned at position 0)
+    best_obj = _objective(score, order)
+    for _ in range(MAX_2OPT_PASSES):
+        improved = False
+        for i in range(1, k - 1):
+            for j in range(i + 1, k):
+                cand = order[:i] + list(reversed(order[i:j + 1])) + order[j + 1:]
+                obj = _objective(score, cand)
+                if obj > best_obj:
+                    order, best_obj = cand, obj
+                    improved = True
+        if not improved:
+            break
+    return tuple(order)
+
+
+def peer_throughput_weights(bw: np.ndarray) -> Optional[Tuple[float, ...]]:
+    """Per-RANK throughput weights from the matrix: the mean of each
+    peer's known outgoing estimates (its measured ability to move
+    bytes), clamped to ``mean/WEIGHT_CLAMP .. mean*WEIGHT_CLAMP`` and
+    normalized to sum 1. None when unmeasured or effectively uniform
+    (equal segments already optimal)."""
+    m = np.asarray(bw, np.float64)
+    k = m.shape[0]
+    mask = np.isfinite(m) & (m > 0)
+    np.fill_diagonal(mask, False)
+    if not mask.any():
+        return None
+    fill = float(np.median(m[mask]))
+    rows = np.where(mask, m, fill)
+    np.fill_diagonal(rows, 0.0)
+    per_rank = rows.sum(axis=1) / max(1, k - 1)
+    return weights_from_throughput(per_rank)
+
+
+def weights_from_throughput(
+    throughput: Sequence[float],
+) -> Optional[Tuple[float, ...]]:
+    """Normalize measured per-peer throughputs into segment weights:
+    clamp the spread to ``WEIGHT_CLAMP`` around the mean (a bad estimate
+    shifts work, never zeroes a peer out), normalize to sum 1, round for
+    canonical bytes. None when the result is effectively uniform."""
+    t = np.asarray([float(x) for x in throughput], np.float64)
+    if t.size == 0 or not np.isfinite(t).all() or (t <= 0).any():
+        return None
+    mean = float(t.mean())
+    t = np.clip(t, mean / WEIGHT_CLAMP, mean * WEIGHT_CLAMP)
+    t = t / t.sum()
+    if np.allclose(t, 1.0 / t.size, rtol=1e-3, atol=1e-9):
+        return None
+    return tuple(round(float(x), 9) for x in t)
+
+
+def segment_weights(
+    order: Sequence[int], rank_weights: Sequence[float]
+) -> Tuple[float, ...]:
+    """Re-index per-RANK weights into per-SEGMENT weights: segment ``s``
+    is owned by the member at ring position ``(s - 1) % k``
+    (SegmentedSchedule.owned_segment), so its weight is that rank's."""
+    k = len(order)
+    return tuple(
+        rank_weights[order[(s - 1) % k]] for s in range(k)
+    )
+
+
+def min_edge_bw(bw: np.ndarray, order: Sequence[int]) -> Optional[float]:
+    """Slowest MEASURED ring edge of ``order`` (None when the ring
+    touches no estimated edge) — the denominator of predicted gain."""
+    m = np.asarray(bw, np.float64)
+    vals = [
+        float(m[i, j]) for i, j in _ring_edges(order)
+        if np.isfinite(m[i, j]) and m[i, j] > 0
+    ]
+    return min(vals) if vals else None
+
+
+def derive_plan(
+    bw: np.ndarray,
+    mode: str = "auto",
+    current: Optional[RingPlan] = None,
+) -> Optional[RingPlan]:
+    """Turn the merged k×k bandwidth matrix into a :class:`RingPlan`,
+    or None when re-planning would be a no-op (no estimates, uniform
+    matrix, or the derived plan equals the current one).
+
+    ``mode`` mirrors ``KF_CONFIG_REPLAN``: ``ring`` reorders only,
+    ``ring+segments``/``auto`` also weight the segments by measured
+    per-peer throughput. Pure function of (matrix bytes, mode, current
+    plan) — the cross-peer determinism the adoption digest asserts."""
+    if mode in ("off", ""):
+        return None
+    if mode not in ("ring", "ring+segments", "auto"):
+        raise ValueError(f"unknown replan mode: {mode!r}")
+    m = np.asarray(bw, np.float64)
+    k = int(m.shape[0])
+    if k < 2 or m.shape != (k, k):
+        return None
+    order = ring_order(m)
+    weights: Optional[Tuple[float, ...]] = None
+    if mode in ("ring+segments", "auto"):
+        rank_w = peer_throughput_weights(m)
+        if rank_w is not None:
+            weights = segment_weights(order, rank_w)
+    cur_order = current.order if current is not None else tuple(range(k))
+    cur_weights = current.weights if current is not None else None
+    if order == cur_order and weights == cur_weights:
+        return None
+    old_min = min_edge_bw(m, cur_order)
+    new_min = min_edge_bw(m, order)
+    gain = 1.0
+    if old_min and new_min and old_min > 0:
+        gain = new_min / old_min
+    return RingPlan(order=order, weights=weights, gain=round(gain, 6))

@@ -13,7 +13,7 @@ edges cross the network — on TPU pods this is the DCN between VM hosts.
 from __future__ import annotations
 
 import dataclasses
-from typing import List, Tuple
+from typing import List, Optional, Sequence, Tuple
 
 from kungfu_tpu.plan.graph import Graph
 from kungfu_tpu.plan.peer import PeerList
@@ -175,19 +175,50 @@ class SegmentedSchedule:
         return (self.index + 1) % self.k
 
 
-def owned_segment_bounds(count: int, k: int, index: int) -> Tuple[int, int]:
-    """(begin, end) element bounds of the segment ring member ``index``
-    owns fully reduced after the reduce-scatter phase — THE shard layout
+def segment_bounds(
+    count: int, k: int, weights: Optional[Sequence[float]] = None
+) -> List[Tuple[int, int]]:
+    """THE segment partition of a k-ring payload: equal contiguous
+    segments, or throughput-proportional ones when a measured plan
+    supplies ``weights`` (ISSUE 14). Single-sourced so the walk engine,
+    the owned-shard layout and every test derive identical bounds."""
+    from kungfu_tpu.base.workspace import even_partition
+
+    if weights is None:
+        return even_partition(count, k)
+    from kungfu_tpu.plan.replan import weighted_partition
+
+    if len(weights) != k:
+        raise ValueError(f"{len(weights)} weights for a ring of {k}")
+    return weighted_partition(count, weights)
+
+
+def owned_segment_bounds(
+    count: int,
+    k: int,
+    index: int,
+    order: Optional[Sequence[int]] = None,
+    weights: Optional[Sequence[float]] = None,
+) -> Tuple[int, int]:
+    """(begin, end) element bounds of the segment rank ``index`` owns
+    fully reduced after the reduce-scatter phase — THE shard layout
     of the ZeRO-1 sharded update (ISSUE 11). Single-sourced here so the
     walk engine's segment math and the sharded optimizer's shard views
     can never disagree: both call this, both get
-    ``even_partition(count, k)[owned_segment]``. k == 1 owns everything."""
-    from kungfu_tpu.base.workspace import even_partition
+    ``segment_bounds(count, k, weights)[owned_segment]``.
 
+    With a measured-topology plan (ISSUE 14) pass its ring ``order``
+    (ranks in ring order) and optional per-segment ``weights``: the
+    owned segment follows the rank's POSITION in the reordered ring and
+    the weighted partition, exactly as the reordered walk computes it —
+    a plan change re-shards through this one function. Without a plan,
+    ``index`` doubles as the ring position (the naive rank-order ring).
+    k == 1 owns everything."""
     if k <= 1:
         return (0, count)
-    sched = gen_segmented_schedule(list(range(k)), index)
-    return even_partition(count, k)[sched.owned_segment]
+    members = list(order) if order is not None else list(range(k))
+    sched = gen_segmented_schedule(members, members.index(index))
+    return segment_bounds(count, k, weights)[sched.owned_segment]
 
 
 def gen_segmented_schedule(ranks: List[int], index: int) -> SegmentedSchedule:

@@ -255,6 +255,95 @@ class StragglerPolicy(BasePolicy):
         self._seen = {p: 0 for p in self._seen}
 
 
+class ReplanPolicy(BasePolicy):
+    """Measured-topology re-planning driver (ISSUE 14 / ROADMAP item 2):
+    watches the measured network signals — ``links/slowest_edge`` /
+    ``links/min_bw`` from the link plane and ``step/critical_edge`` from
+    the step plane — and, when the SAME edge keeps being named for
+    ``patience`` consecutive signal refreshes, votes to re-derive the
+    ring from the measured matrix via ``HostSession.check_replan``.
+
+    The check itself is a lockstep collective round, so it runs every
+    ``interval_steps`` steps ON EVERY PEER regardless of this peer's
+    local suspicion (peers that see nothing vote no; the majority
+    decides — the same shape as the interference vote). Steps advance in
+    lockstep under synchronous training, which is what makes the step
+    counter a valid cross-peer gate. The switch lands at a step
+    boundary: call it from ``after_step`` (this class) or anywhere no
+    walk is in flight.
+
+    ``KF_CONFIG_REPLAN`` (cluster-agreed) gates the whole machinery:
+    with it ``off`` (the default) ``check_replan`` is a local no-op and
+    this policy never runs a collective. On adoption the session emits a
+    ``topology_replanned`` audit event naming old→new order and the
+    predicted gain; ``ctx.metrics['replan/last_order']`` mirrors it for
+    embedders."""
+
+    def __init__(
+        self,
+        interval_steps: int = 32,
+        patience: int = 3,
+        min_gain: float = 1.05,
+        session_supplier: Optional[Callable[[], object]] = None,
+    ):
+        if interval_steps < 1:
+            raise ValueError("interval_steps must be >= 1")
+        self.interval_steps = interval_steps
+        self.patience = patience
+        self.min_gain = min_gain
+        self._session_supplier = session_supplier
+        self._edge = None  # the persistently-named edge being watched
+        self._streak = 0
+        self._last_update = None
+
+    def _session(self):
+        if self._session_supplier is not None:
+            return self._session_supplier()
+        try:
+            from kungfu_tpu.peer import get_default_peer
+
+            return get_default_peer().current_session()
+        except Exception as e:  # noqa: BLE001 - no peer = nothing to re-plan
+            log.debug("replan policy: no session: %s", e)
+            return None
+
+    def _observe(self, ctx: "PolicyContext") -> None:
+        """Track how long the same measured edge has been the named
+        bottleneck. Counted once per signal REFRESH when the cluster
+        plane stamps one (cluster/updated_at — the StragglerPolicy
+        discipline), else once per step off the worker-local signals."""
+        edge = ctx.metrics.get("step/critical_edge")
+        if edge is None:
+            slowest = ctx.metrics.get("links/slowest_edge")
+            edge = slowest[-1] if isinstance(slowest, (list, tuple)) and slowest else None
+        if edge is None:
+            return
+        update = ctx.metrics.get("cluster/updated_at")
+        if update is not None and update == self._last_update:
+            return
+        self._last_update = update
+        edge = str(edge)
+        if edge == self._edge:
+            self._streak += 1
+        else:
+            self._edge, self._streak = edge, 1
+
+    def after_step(self, ctx: "PolicyContext") -> None:
+        self._observe(ctx)
+        if ctx.step == 0 or ctx.step % self.interval_steps:
+            return
+        sess = self._session()
+        if sess is None or getattr(sess, "size", 1) < 2:
+            return
+        want = self._streak >= self.patience
+        plan = sess.check_replan(want=want, min_gain=self.min_gain)
+        if plan is not None:
+            # adopted: restart the watch window against the new topology
+            self._edge, self._streak = None, 0
+            ctx.metrics["replan/last_order"] = list(plan.order)
+            ctx.metrics["replan/predicted_gain"] = plan.gain
+
+
 class _Scope:
     def __init__(self, enter, exit):
         self._enter = enter

@@ -70,6 +70,11 @@ LINK_BW = "kungfu_link_bandwidth_bytes_per_second"
 LINK_LAT = "kungfu_link_latency_seconds"
 LINK_BYTES = "kungfu_link_tx_bytes_total"
 LINK_MSGS = "kungfu_link_tx_messages_total"
+# active-ring families (ISSUE 14): each worker exports its position in
+# the current segmented-ring order and its successor edge, so
+# /cluster/links can render the ACTIVE ring next to the measured matrix
+RING_POS = "kungfu_topology_ring_position"
+RING_NEXT = "kungfu_topology_ring_next"
 
 CLOCK_HEADER = "X-KF-Perf-Now-Us"
 
@@ -196,6 +201,10 @@ class PeerState:
         # this peer's link-matrix row, parsed off its last exposition:
         # {dst: {"bw":, "latency_s":, "tx_bytes":, "tx_messages":}}
         self.links: Dict[str, dict] = {}
+        # active-ring view (ISSUE 14): this peer's position in the
+        # current ring order and its successor peer label
+        self.ring_pos: Optional[int] = None
+        self.ring_next: Optional[str] = None
 
 
 class TelemetryAggregator:
@@ -412,6 +421,7 @@ class TelemetryAggregator:
             # and its link row: a dead peer's frozen bandwidth estimates
             # would keep steering topology re-planning hours later
             st.links = {}
+            st.ring_pos = st.ring_next = None
             self.scorer.drop(st.label)
             self.rtt_scorer.drop(st.label)
             return
@@ -428,6 +438,8 @@ class TelemetryAggregator:
         coll_sum = None
         rtts = []
         links: Dict[str, dict] = {}
+        ring_pos = None
+        ring_next = None
         _link_key = {
             LINK_BW: "bw", LINK_LAT: "latency_s",
             LINK_BYTES: "tx_bytes", LINK_MSGS: "tx_messages",
@@ -443,11 +455,17 @@ class TelemetryAggregator:
                 coll_sum = (coll_sum or 0.0) + s.value
             elif s.name == PEER_RTT and math.isfinite(s.value) and s.value > 0:
                 rtts.append(s.value)
+            elif s.name == RING_POS:
+                ring_pos = int(s.value)
+            elif s.name == RING_NEXT and s.value:
+                ring_next = s.labels_dict().get("dst") or ring_next
             elif s.name in _link_key:
                 dst = s.labels_dict().get("dst")
                 if dst:
                     links.setdefault(dst, {})[_link_key[s.name]] = s.value
         st.links = links
+        st.ring_pos = ring_pos
+        st.ring_next = ring_next
         st.coll_sum = coll_sum
         st.bytes_tx, st.bytes_rx = tx, rx
         st.reported_rtt = sorted(rtts)[len(rtts) // 2] if rtts else None
@@ -748,6 +766,28 @@ class TelemetryAggregator:
         doc["wall_time"] = self._scraped_at
         doc["clock_offset_us"] = {
             st.label: st.clock_offset_us for st in self.peers()
+        }
+        # active-ring view (ISSUE 14): reconstruct the ring order the
+        # workers are actually walking from their exported positions;
+        # only published when every scraped peer reported a distinct
+        # position (mid-re-plan or partially-scraped clusters return
+        # null rather than a half-true ring)
+        positions = {
+            st.label: st.ring_pos for st in self.peers()
+            if st.ring_pos is not None
+        }
+        order = None
+        if positions and len(positions) == len(self.peers()):
+            by_pos = sorted(positions.items(), key=lambda kv: kv[1])
+            if [p for _, p in by_pos] == list(range(len(by_pos))):
+                order = [label for label, _ in by_pos]
+        doc["ring"] = {
+            "order": order,
+            "position": positions,
+            "next": {
+                st.label: st.ring_next for st in self.peers()
+                if st.ring_next is not None
+            },
         }
         return doc
 

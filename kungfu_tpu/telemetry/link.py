@@ -215,6 +215,11 @@ class LinkTable:
             est = self._links.get(str(dst))
             return est.bw if est is not None else None
 
+    def latency(self, dst) -> Optional[float]:
+        with self._lock:
+            est = self._links.get(str(dst))
+            return est.latency if est is not None else None
+
     def min_bandwidth(
         self, dsts: Optional[Sequence] = None
     ) -> Tuple[Optional[str], Optional[float]]:

@@ -51,39 +51,6 @@ def _retry_delays():
         d = min(d * CONN_RETRY_GROWTH, CONN_RETRY_PERIOD)
 
 
-def _parse_slow_edge(self_spec: str):
-    """Test-only fault injection (ISSUE 13 e2e): KF_TEST_SLOW_EDGE =
-    `[src>]dst=ms` delays every send from src (this worker, when its
-    KF_SELF_SPEC matches; any worker when src is omitted) toward dst.
-    Returns (dst_spec, seconds) or None. Parsed once at client
-    construction — the knob is static for a process's life."""
-    from kungfu_tpu import knobs
-
-    raw = knobs.raw("KF_TEST_SLOW_EDGE").strip()
-    if not raw:
-        return None
-    try:
-        edge, ms = raw.rsplit("=", 1)
-        src, _, dst = edge.rpartition(">")
-        seconds = float(ms) / 1e3
-        if not dst or seconds <= 0:
-            raise ValueError(raw)
-    except ValueError:
-        # a typo'd injection knob must not silently become "no delay"
-        # — the e2e would fail two minutes later with nothing pointing
-        # at the cause
-        from kungfu_tpu.telemetry import log
-
-        log.warn(
-            "KF_TEST_SLOW_EDGE: malformed value %r (want `[src>]dst=ms`)"
-            " — no edge delay injected", raw,
-        )
-        return None
-    if src and src != self_spec:
-        return None
-    return dst, seconds
-
-
 class Client:
     def __init__(self, self_id: PeerID, use_unix: bool = True):
         self.self_id = self_id
@@ -107,8 +74,14 @@ class Client:
         from kungfu_tpu.telemetry import link as _link
 
         self._links = _link.get_table() if _link.enabled() else None
-        # test-only shaped edge (step-plane e2e): None in production
-        self._slow_edge = _parse_slow_edge(str(self_id))
+        # shaped-link harness (ISSUE 14; generalizes the old slow-edge
+        # injection): per-edge latency/bandwidth/jitter from
+        # KF_SHAPE_LINKS, matched against THIS client's own peer id so
+        # in-process multi-peer harnesses shape per sender. None in
+        # production; parsed once — the knob is static per process.
+        from kungfu_tpu.transport import shaping as _shaping
+
+        self._shaper = _shaping.from_env(str(self_id))
         # latency histograms ride the same gate as the byte counters: a
         # histogram observe is a bisect + three adds, but the send path
         # runs per message and stays untouched when telemetry is off
@@ -264,16 +237,16 @@ class Client:
                 if shm_conn:
                     self._fresh_arena(key)
             _t0 = time.perf_counter()
-            if (
-                self._slow_edge is not None
-                and str(peer) == self._slow_edge[0]
-            ):
-                # inside the timed window on purpose: the injected delay
-                # must surface everywhere a real slow edge would — the
-                # link table's bandwidth estimate, the walk profiler's
-                # send-blocked split and the step plane's critical edge
-                # kfcheck: disable=KF200 — deliberate test-only edge shaping: holding the per-connection lock through the delay serializes the edge exactly like a saturated pipe would
-                time.sleep(self._slow_edge[1])
+            if self._shaper is not None:
+                delay = self._shaper.delay(peer, data_len)
+                if delay > 0:
+                    # inside the timed window on purpose: the shaped
+                    # delay must surface everywhere a real slow edge
+                    # would — the link table's bandwidth estimate, the
+                    # walk profiler's send-blocked split and the step
+                    # plane's critical edge
+                    # kfcheck: disable=KF200 — deliberate test-only edge shaping: holding the per-connection lock through the delay serializes the edge exactly like a saturated pipe would
+                    time.sleep(delay)
             try:
                 send_message(sock, wire_message())
             except (ConnectionError, OSError):
@@ -308,6 +281,13 @@ class Client:
         try:
             _t0 = time.perf_counter()
             sock = socket.create_connection((peer.host, peer.port), timeout=timeout)
+            if self._shaper is not None:
+                # shaped message latency inside the timed RTT window:
+                # the link table's latency estimate (fed by this ping)
+                # must observe the same shape the collective sends do
+                delay = self._shaper.latency(peer)
+                if delay > 0:
+                    time.sleep(delay)
             send_header(sock, ConnType.PING, self.self_id.host, self.self_id.port, 0)
             recv_ack(sock)
             sock.close()

@@ -1,0 +1,267 @@
+"""Shaped-link transport harness (ISSUE 14 tentpole, part c).
+
+``KF_SHAPE_LINKS`` generalizes the one-off ``KF_TEST_SLOW_EDGE`` fault
+injection into a per-edge latency/bandwidth/jitter shaper, so one box
+can emulate a multi-host DCN at k=32–64 and the measured-topology
+re-planner has something measurable to win against.
+
+Grammar (documented in docs/knobs.md)::
+
+    KF_SHAPE_LINKS = entry [';' entry]...
+    entry          = ['src' '>'] dst '=' param [',' param]...
+    param          = 'lat:'    ms        # one-way latency per message
+                   | 'bw:'     rate      # token-bucket pacing; rate is
+                                         # bytes/sec, with KiB/MiB/GiB
+                                         # (optionally 'ps') suffixes
+                   | 'jitter:' ms        # deterministic 0..jitter extra
+
+``dst`` (and the optional ``src``) are ``host:port`` peer specs; ``*``
+as dst matches every destination (the most specific entry wins: exact
+dst beats ``*``). An entry with a ``src`` applies only on the sender
+whose peer id matches — in-process multi-peer harnesses match against
+each Client's OWN id, not the process env, so one process can host both
+ends of an asymmetric shape.
+
+The delay is applied INSIDE the transport's timed send window while the
+per-connection lock is held (the caller does the sleeping): exactly
+like a saturated pipe, the shaped edge serializes, the link table's
+passive bandwidth estimate converges to the shaped rate, the walk
+profiler books the time as send-blocked, and the step plane elects the
+shaped edge as critical — every observability surface sees the same
+link the engine experiences.
+
+Jitter is DETERMINISTIC (an LCG over a per-edge message counter, no
+RNG): reruns of a shaped bench see identical delay sequences, so paired
+A/B ratios stay drift-free.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Dict, Optional, Tuple
+
+# token-bucket burst: how many bytes may pass unpaced after an idle
+# period (seconds of credit at the shaped rate). Small enough that a
+# steady collective stream converges to the shaped bandwidth within one
+# segment, large enough that control frames don't pay a pacing stall.
+BURST_SECONDS = 0.02
+BURST_MIN_BYTES = 64 << 10
+
+_RATE_SUFFIX = {
+    "kib": 1 << 10, "mib": 1 << 20, "gib": 1 << 30,
+    "kb": 1000, "mb": 1000_000, "gb": 1000_000_000,
+    "k": 1 << 10, "m": 1 << 20, "g": 1 << 30,
+}
+
+
+def _parse_rate(s: str) -> float:
+    """`20MiB`/`5mb`/`1.5G`[ps] → bytes/sec."""
+    raw = s.strip().lower()
+    if raw.endswith("ps"):
+        raw = raw[:-2]
+    raw = raw.rstrip("/s")
+    for suffix in sorted(_RATE_SUFFIX, key=len, reverse=True):
+        if raw.endswith(suffix):
+            return float(raw[: -len(suffix)]) * _RATE_SUFFIX[suffix]
+    return float(raw)
+
+
+class EdgeShape:
+    """Shape parameters of one directed edge."""
+
+    __slots__ = ("lat_s", "bw_bps", "jitter_s")
+
+    def __init__(self, lat_s: float = 0.0, bw_bps: float = 0.0,
+                 jitter_s: float = 0.0):
+        self.lat_s = float(lat_s)
+        self.bw_bps = float(bw_bps)
+        self.jitter_s = float(jitter_s)
+
+    def __repr__(self) -> str:
+        return (f"EdgeShape(lat={self.lat_s * 1e3:g}ms, "
+                f"bw={self.bw_bps:g}B/s, jitter={self.jitter_s * 1e3:g}ms)")
+
+
+def _parse_entry(entry: str) -> Optional[Tuple[str, str, EdgeShape]]:
+    """One `[src>]dst=params` entry → (src or '', dst, EdgeShape)."""
+    edge, sep, params = entry.partition("=")
+    if not sep:
+        raise ValueError(f"missing '=' in {entry!r}")
+    src, _, dst = edge.strip().rpartition(">")
+    src, dst = src.strip(), dst.strip()
+    if not dst:
+        raise ValueError(f"missing destination in {entry!r}")
+    shape = EdgeShape()
+    for param in params.split(","):
+        param = param.strip()
+        if not param:
+            continue
+        key, sep, val = param.partition(":")
+        if not sep:
+            raise ValueError(f"malformed param {param!r} (want key:value)")
+        key = key.strip().lower()
+        if key == "lat":
+            shape.lat_s = float(val) / 1e3
+        elif key == "bw":
+            shape.bw_bps = _parse_rate(val)
+        elif key == "jitter":
+            shape.jitter_s = float(val) / 1e3
+        else:
+            raise ValueError(f"unknown shape key {key!r} in {entry!r}")
+    if shape.lat_s < 0 or shape.bw_bps < 0 or shape.jitter_s < 0:
+        raise ValueError(f"negative shape value in {entry!r}")
+    if shape.lat_s == 0 and shape.bw_bps == 0 and shape.jitter_s == 0:
+        return None  # an all-zero entry shapes nothing
+    return src, dst, shape
+
+
+def parse_spec(spec: str, self_spec: str) -> Dict[str, EdgeShape]:
+    """Parse a KF_SHAPE_LINKS spec into {dst: EdgeShape} for THIS sender
+    (entries whose src doesn't match ``self_spec`` are dropped; dst may
+    be '*'). Malformed entries raise ValueError — callers decide whether
+    to warn-and-skip (env path) or fail (tests)."""
+    shapes: Dict[str, EdgeShape] = {}
+    for entry in spec.split(";"):
+        entry = entry.strip()
+        if not entry:
+            continue
+        parsed = _parse_entry(entry)
+        if parsed is None:
+            continue
+        src, dst, shape = parsed
+        if src and src != "*" and src != self_spec:
+            continue
+        shapes[dst] = shape
+    return shapes
+
+
+class LinkShaper:
+    """Per-destination token-bucket pacer + latency/jitter injector.
+
+    :meth:`delay` computes (under the shaper's own lock — no sleeping
+    inside it) how long the CALLER must sleep before a send of
+    ``nbytes`` toward ``dst`` so the edge behaves like the shaped link;
+    :meth:`latency` is the message-latency-only variant for pings."""
+
+    def __init__(self, shapes: Dict[str, EdgeShape],
+                 clock=time.monotonic):
+        self._shapes = dict(shapes)
+        self._clock = clock
+        self._lock = threading.Lock()
+        # per-dst token-bucket state: (tokens, last_refill_ts)
+        self._buckets: Dict[str, Tuple[float, float]] = {}
+        # per-dst message counter driving the deterministic jitter LCG
+        self._counts: Dict[str, int] = {}
+
+    def __bool__(self) -> bool:
+        return bool(self._shapes)
+
+    def shape_for(self, dst: str) -> Optional[EdgeShape]:
+        """Most specific match: exact dst, else the '*' wildcard."""
+        return self._shapes.get(str(dst)) or self._shapes.get("*")
+
+    def _jitter(self, key: str, shape: EdgeShape) -> float:
+        """`key` is the counter stream — sends and pings keep SEPARATE
+        streams per dst: pings fire on wall-clock schedules, so sharing
+        one counter would make the send-side jitter sequence depend on
+        ping timing and break the rerun-determinism the module
+        guarantees (review finding)."""
+        if shape.jitter_s <= 0:
+            return 0.0
+        n = self._counts.get(key, 0)
+        self._counts[key] = n + 1
+        # deterministic LCG over the per-edge message counter: identical
+        # across reruns (no RNG — the span-sampler discipline)
+        frac = ((n * 1103515245 + 12345) % (1 << 31)) / float(1 << 31)
+        return shape.jitter_s * frac
+
+    def delay(self, dst, nbytes: int) -> float:
+        """Seconds the caller should sleep before sending ``nbytes`` to
+        ``dst`` (0.0 when the edge is unshaped or within its burst)."""
+        key = str(dst)
+        shape = self.shape_for(key)
+        if shape is None:
+            return 0.0
+        with self._lock:
+            d = shape.lat_s + self._jitter(key, shape)
+            if shape.bw_bps > 0:
+                now = self._clock()
+                burst = max(BURST_MIN_BYTES, shape.bw_bps * BURST_SECONDS)
+                tokens, last = self._buckets.get(key, (burst, now))
+                tokens = min(burst, tokens + (now - last) * shape.bw_bps)
+                tokens -= nbytes
+                if tokens < 0:
+                    # the caller sleeps the deficit off; KEEP the debt
+                    # negative — the sleep period's refill (next call's
+                    # elapsed-time credit) pays it back, so clamping to
+                    # zero here would double-credit the sleep and pace
+                    # ~30% above the shaped rate
+                    d += -tokens / shape.bw_bps
+                self._buckets[key] = (tokens, now)
+            return d
+
+    def latency(self, dst) -> float:
+        """Latency+jitter only (ping-sized traffic never pays pacing)."""
+        key = str(dst)
+        shape = self.shape_for(key)
+        if shape is None:
+            return 0.0
+        with self._lock:
+            return shape.lat_s + self._jitter("ping:" + key, shape)
+
+
+def _slow_edge_as_spec(raw: str) -> str:
+    """Translate the DEPRECATED KF_TEST_SLOW_EDGE `[src>]dst=ms` into a
+    KF_SHAPE_LINKS entry `[src>]dst=lat:ms`."""
+    edge, sep, ms = raw.rpartition("=")
+    if not sep or not edge.strip():
+        raise ValueError(raw)
+    float(ms)  # malformed delay must raise here, not parse as a shape key
+    return f"{edge.strip()}=lat:{ms.strip()}"
+
+
+def from_env(self_spec: str) -> Optional[LinkShaper]:
+    """Build the process shaper from KF_SHAPE_LINKS (+ the deprecated
+    KF_TEST_SLOW_EDGE alias, which warns but keeps injecting — a stale
+    e2e env must not silently become 'no delay'). None when unshaped.
+    Malformed specs warn and shape nothing rather than killing the
+    worker — but loudly, so a typo'd harness doesn't surface as an
+    unexplained timeout two minutes later."""
+    from kungfu_tpu import knobs
+    from kungfu_tpu.telemetry import log
+
+    spec = knobs.raw("KF_SHAPE_LINKS").strip()
+    legacy = knobs.raw("KF_TEST_SLOW_EDGE").strip()
+    if legacy:
+        try:
+            legacy_entry = _slow_edge_as_spec(legacy)
+        except ValueError:
+            log.warn(
+                "KF_TEST_SLOW_EDGE: malformed value %r (want `[src>]dst"
+                "=ms`) — no edge delay injected", legacy,
+            )
+        else:
+            log.warn(
+                "KF_TEST_SLOW_EDGE is deprecated — use KF_SHAPE_LINKS="
+                "%r", legacy_entry,
+            )
+            # legacy entries go FIRST: parse_spec is last-wins per dst,
+            # so an explicit KF_SHAPE_LINKS entry for the same
+            # destination overrides a stale alias, not the other way
+            # around (review finding)
+            spec = f"{legacy_entry};{spec}" if spec else legacy_entry
+    if not spec:
+        return None
+    try:
+        shapes = parse_spec(spec, self_spec)
+    except ValueError as e:
+        log.warn(
+            "KF_SHAPE_LINKS: malformed spec (%s) — NO link shaping "
+            "injected; fix the spec (`[src>]dst=lat:ms,bw:rate,"
+            "jitter:ms; ...`)", e,
+        )
+        return None
+    if not shapes:
+        return None
+    return LinkShaper(shapes)

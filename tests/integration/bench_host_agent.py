@@ -26,8 +26,12 @@ def main() -> None:
         argv += ["--wire-ab"]
     if os.environ.get("KF_BENCH_ASYNC", ""):
         argv += ["--async"]
+    if os.environ.get("KF_BENCH_PASSES", ""):
+        argv += ["--passes", os.environ["KF_BENCH_PASSES"]]
     if os.environ.get("KF_BENCH_ZERO", ""):
         argv += ["--zero"]
+    if os.environ.get("KF_BENCH_REPLAN", ""):
+        argv += ["--replan"]
     if os.environ.get("KF_BENCH_STEPS", ""):
         argv += ["--steps"]
     sys.argv = argv

@@ -50,6 +50,13 @@ def clean_run(sess, rank: int, size: int) -> None:
         sched.flush()
         for o in outs:
             assert np.all(o == expected), o[:4]
+    # a lockstep measured-topology re-plan round (ISSUE 14): the vote,
+    # row exchange and adoption digest must look symmetric to the
+    # sentinel too (the harness runs this agent under KF_SHAPE_LINKS +
+    # KF_CONFIG_REPLAN, so this is the "clean shaped run" acceptance)
+    if sess.replan_mode != "off":
+        api.check_replan(want=True, min_gain=1.0)
+        assert protowatch.check(sess), "re-plan round flagged divergent"
     st = protowatch.stats(sess)
     assert st["checks"] >= 5, st
     assert st["divergences"] == 0, st

@@ -6,7 +6,7 @@ injected slow edge on /cluster/steps (KF_TEST_DONE_FILE), so the
 runner-side window is bounded by the test, not a fixed sleep.
 
 Run with KF_CONFIG_ASYNC=on and (for a deterministic ring successor)
-KF_CONFIG_ALGO=segmented; the harness injects KF_TEST_SLOW_EDGE so one
+KF_CONFIG_ALGO=segmented; the harness injects KF_SHAPE_LINKS so one
 peer's sends toward its ring successor carry a fixed delay.
 """
 

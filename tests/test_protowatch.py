@@ -65,8 +65,13 @@ def test_np4_live_bench_clean_under_sentinel():
     """Acceptance: a healthy np=4 workload — sync rounds with explicit
     boundary checks plus async scheduler rounds whose flushes
     auto-check — must come back agreed on every peer, zero divergence
-    events (the sentinel must not cry wolf on real overlapped traffic)."""
-    r = _run(4)
+    events (the sentinel must not cry wolf on real overlapped traffic).
+    Runs SHAPED with a lockstep re-plan round (ISSUE 14): the shaped
+    harness + vote/exchange/adopt collectives must stay silent too."""
+    r = _run(4, extra_env={
+        "KF_SHAPE_LINKS": "127.0.0.1:38001>127.0.0.1:38002=lat:5",
+        "KF_CONFIG_REPLAN": "auto",
+    })
     out = r.stdout + r.stderr
     assert r.returncode == 0, out
     assert out.count("CLEAN-OK") == 4, out

@@ -1,0 +1,914 @@
+"""Measured-topology re-planning (ISSUE 14 tentpole).
+
+Covers: the pure planning algebra — ``weighted_partition`` properties
+(contiguous, lossless, monotone in weights, degenerate all-zero /
+one-peer / n<k), the ring-order optimizer (valid permutation,
+deterministic, identical from identical matrices, no-op on a uniform
+matrix, avoids a slowed directed edge, groups hosts under a DCN-shaped
+matrix) and plan serialization; the plan-aware owned-segment layout
+(single-sourced partition under reorder + weights); and the LIVE engine
+at np in {2,3,4}: reordered + unequal-segment walks bit-identical to
+the naive equal-segment ring on exact payloads, rs+ag under a plan ==
+allreduce, the lockstep check_replan vote (no majority → no-op,
+majority → identical adoption everywhere + topology_replanned audit), a
+divergent matrix-fed plan raising a NAMED error on every peer (never a
+rendezvous hang), KF_CONFIG_REPLAN in the engine-knob consensus, the
+segmented_fallback audit satellite, and a ZeRO-sharded session
+surviving a mid-training re-plan with state re-sharded exactly (plus a
+shrink re-shard landing on a session with a different plan).
+
+Exactness note: live bit-identity cases reduce INTEGER-VALUED payloads
+(associativity-free sums), the test_segmented discipline.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.base.ops import ReduceOp
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.base.workspace import Workspace, even_partition
+from kungfu_tpu.collective.host_session import HostSession
+from kungfu_tpu.collective.zero import ShardedSGD, ShardedUpdateSession
+from kungfu_tpu.peer import Peer
+from kungfu_tpu.plan import replan as rp
+from kungfu_tpu.plan import topology as topo
+from kungfu_tpu.plan.peer import PeerID, PeerList
+from kungfu_tpu.runner.env import WorkerConfig
+from kungfu_tpu.telemetry import audit as taudit
+
+
+# ---------------------------------------------------------------------------
+# weighted_partition properties
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("count", [0, 1, 2, 3, 17, 100, 1001])
+@pytest.mark.parametrize("k", [1, 2, 3, 5, 8])
+def test_weighted_partition_contiguous_lossless(count, k):
+    rng = np.random.default_rng(count * 31 + k)
+    for _ in range(5):
+        w = rng.random(k) + 0.01
+        bounds = rp.weighted_partition(count, w)
+        assert len(bounds) == k
+        pos = 0
+        for b, e in bounds:
+            assert b == pos and e >= b
+            pos = e
+        assert pos == count
+
+
+def test_weighted_partition_proportional():
+    bounds = rp.weighted_partition(100, [1, 3])
+    assert bounds == [(0, 25), (25, 100)]
+    bounds = rp.weighted_partition(8, [1, 1, 2])
+    assert [e - b for b, e in bounds] == [2, 2, 4]
+
+
+def test_weighted_partition_monotone_in_weights():
+    """Growing one weight (others fixed) never shrinks its interval."""
+    rng = np.random.default_rng(5)
+    for _ in range(20):
+        k = int(rng.integers(2, 7))
+        count = int(rng.integers(1, 200))
+        w = (rng.random(k) + 0.05).tolist()
+        i = int(rng.integers(0, k))
+        before = rp.weighted_partition(count, w)
+        w2 = list(w)
+        w2[i] *= 1.0 + float(rng.random())
+        after = rp.weighted_partition(count, w2)
+        assert (after[i][1] - after[i][0]) >= (before[i][1] - before[i][0])
+
+
+def test_weighted_partition_degenerate():
+    # all-zero weights fall back to the even split
+    assert rp.weighted_partition(10, [0, 0, 0]) == even_partition(10, 3)
+    # one peer owns everything
+    assert rp.weighted_partition(7, [3.5]) == [(0, 7)]
+    # n < k produces empty intervals but still tiles [0, n)
+    bounds = rp.weighted_partition(2, [1, 1, 1, 1])
+    assert bounds[0][0] == 0 and bounds[-1][1] == 2
+    sizes = [e - b for b, e in bounds]
+    assert sum(sizes) == 2 and all(s >= 0 for s in sizes)
+    with pytest.raises(ValueError):
+        rp.weighted_partition(10, [1, -1])
+    with pytest.raises(ValueError):
+        rp.weighted_partition(10, [])
+
+
+# ---------------------------------------------------------------------------
+# ring-order optimizer
+# ---------------------------------------------------------------------------
+
+def _uniform(k, bw=100.0):
+    m = np.full((k, k), bw)
+    np.fill_diagonal(m, 0.0)
+    return m
+
+
+def test_ring_order_valid_permutation_and_deterministic():
+    rng = np.random.default_rng(9)
+    for k in (2, 3, 4, 8, 16):
+        m = rng.random((k, k)) * 100 + 1
+        np.fill_diagonal(m, 0.0)
+        order = rp.ring_order(m)
+        assert sorted(order) == list(range(k))
+        assert order[0] == 0  # canonical rotation: rank 0 pinned first
+        assert order == rp.ring_order(m.copy())  # pure + deterministic
+
+
+def test_ring_order_noop_on_uniform_matrix():
+    for k in (2, 3, 8):
+        assert rp.ring_order(_uniform(k)) == tuple(range(k))
+    # no estimates at all: nothing to optimize
+    assert rp.ring_order(np.zeros((5, 5))) == tuple(range(5))
+
+
+def test_ring_order_avoids_slowed_directed_edge():
+    """One slowed directed edge: the optimized ring never crosses it
+    (every other pairing is fast, so max-min-edge must route around)."""
+    for k in (4, 6, 8):
+        m = _uniform(k)
+        m[1, 2] = 1.0  # the shaped edge
+        order = rp.ring_order(m)
+        edges = {(order[i], order[(i + 1) % k]) for i in range(k)}
+        assert (1, 2) not in edges
+
+
+def test_ring_order_groups_hosts_on_dcn_matrix():
+    """Two-host DCN shape with INTERLEAVED host assignment: intra-host
+    edges fast, cross-host edges slow. A ring must cross hosts at least
+    twice; the optimizer should hit exactly that minimum where naive
+    rank order crosses on every hop."""
+    k = 8
+    host = [i % 2 for i in range(k)]  # interleaved: worst case for naive
+    m = np.full((k, k), 200.0)
+    for i in range(k):
+        for j in range(k):
+            if host[i] != host[j]:
+                m[i, j] = 10.0
+    np.fill_diagonal(m, 0.0)
+    order = rp.ring_order(m)
+    crossings = sum(
+        1 for i in range(k)
+        if host[order[i]] != host[order[(i + 1) % k]]
+    )
+    naive_crossings = sum(
+        1 for i in range(k) if host[i] != host[(i + 1) % k]
+    )
+    assert naive_crossings == k  # the shape the naive ring pays
+    assert crossings == 2
+
+
+def test_derive_plan_and_serialization():
+    k = 4
+    m = _uniform(k)
+    m[1, 2] = 1.0
+    m[1, :] *= 0.5  # peer 1 is slow everywhere: weights should shrink it
+    m[1, 1] = 0.0
+    plan = rp.derive_plan(m, mode="auto")
+    assert plan is not None
+    assert sorted(plan.order) == list(range(k))
+    assert plan.weights is not None and len(plan.weights) == k
+    # segment owned by rank 1 gets a smaller weight than the others
+    pos1 = plan.order.index(1)
+    seg1 = (pos1 + 1) % k
+    others = [w for s, w in enumerate(plan.weights) if s != seg1]
+    assert plan.weights[seg1] < min(others)
+    # canonical bytes: identical derivation -> identical digest
+    again = rp.derive_plan(m.copy(), mode="auto")
+    assert again.to_bytes() == plan.to_bytes()
+    assert again.digest() == plan.digest()
+    # ring-only mode never emits weights
+    ring_only = rp.derive_plan(m, mode="ring")
+    assert ring_only.weights is None
+    # uniform matrix: no plan at all
+    assert rp.derive_plan(_uniform(k), mode="auto") is None
+    # deriving against an identical current plan: no-op
+    assert rp.derive_plan(m, mode="auto", current=plan) is None
+    with pytest.raises(ValueError):
+        rp.derive_plan(m, mode="bogus")
+
+
+def test_plan_rejects_bad_shapes():
+    with pytest.raises(ValueError):
+        rp.RingPlan(order=(0, 0, 1))
+    with pytest.raises(ValueError):
+        rp.RingPlan(order=(0, 1), weights=(1.0,))
+
+
+# ---------------------------------------------------------------------------
+# plan-aware owned-segment layout
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("k", [2, 3, 4, 5])
+def test_owned_bounds_follow_plan(k):
+    """Under any (order, weights) plan the per-rank owned shards still
+    tile [0, n) exactly and match the reordered schedule's designated
+    segment of the weighted partition — the single-source contract a
+    re-plan re-shards through."""
+    rng = np.random.default_rng(k)
+    for trial in range(10):
+        order = [0] + list(rng.permutation(np.arange(1, k)))
+        weights = tuple((rng.random(k) + 0.1).tolist()) if trial % 2 else None
+        for n in (1, k - 1, k, 2 * k + 1, 997):
+            bounds = topo.segment_bounds(n, k, weights)
+            shards = [
+                topo.owned_segment_bounds(n, k, r, order=order,
+                                          weights=weights)
+                for r in range(k)
+            ]
+            covered = sorted(shards)
+            pos = 0
+            for b, e in covered:
+                assert b == pos
+                pos = e
+            assert pos == n
+            for r in range(k):
+                sched = topo.gen_segmented_schedule(
+                    list(order), list(order).index(r)
+                )
+                assert shards[r] == bounds[sched.owned_segment]
+
+
+# ---------------------------------------------------------------------------
+# live-cluster harness (the test_segmented pattern)
+# ---------------------------------------------------------------------------
+
+def make_peer_cluster(n):
+    from kungfu_tpu.cmd import _reserve_ports
+
+    ports = _reserve_ports(n)
+    ids = [PeerID("127.0.0.1", p) for p in ports]
+    peers = PeerList(ids)
+    out = []
+    for me in ids:
+        cfg = WorkerConfig(
+            self_id=me,
+            peers=peers,
+            runners=PeerList(),
+            parent=None,
+            cluster_version=0,
+            strategy=Strategy.STAR,
+            config_server="",
+            elastic_mode="",
+            init_progress=0,
+        )
+        out.append(Peer(cfg))
+    threads = [threading.Thread(target=p.start) for p in out]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(120)
+        assert not t.is_alive(), "peer start timed out"
+    return out
+
+
+@pytest.fixture(scope="module")
+def clusters():
+    built = {}
+
+    def get(n):
+        if n not in built:
+            built[n] = make_peer_cluster(n)
+        return built[n]
+
+    yield get
+    for ps in built.values():
+        for p in ps:
+            p.stop()
+
+
+def _sessions(cluster, strategy=Strategy.RING_SEGMENTED, timeout=60.0,
+              subset=None):
+    members = cluster if subset is None else cluster[:subset]
+    peer_list = PeerList(list(p.self_id for p in members))
+    return [
+        HostSession(strategy, p.self_id, peer_list, p.client, p.collective,
+                    timeout=timeout)
+        for p in members
+    ]
+
+
+def _run_on_all(fns, join=120):
+    errs = []
+
+    def wrap(fn):
+        try:
+            fn()
+        except BaseException as e:  # noqa: BLE001 - re-raised below
+            errs.append(e)
+
+    ts = [threading.Thread(target=wrap, args=(fn,)) for fn in fns]
+    for t in ts:
+        t.start()
+    for t in ts:
+        t.join(join)
+        assert not t.is_alive(), "collective hung"
+    if errs:
+        raise errs[0]
+
+
+def _test_plan(k, weighted=True, seed=0):
+    """A deterministic non-trivial plan for a k-ring: a rotated-ish
+    permutation with rank 0 pinned, optionally unequal weights."""
+    rng = np.random.default_rng(1234 + k + seed)
+    order = (0,) + tuple(int(x) for x in rng.permutation(np.arange(1, k)))
+    weights = None
+    if weighted and k > 1:
+        w = rng.random(k) + 0.2
+        w = w / w.sum()
+        weights = tuple(round(float(x), 9) for x in w)
+    return rp.RingPlan(order=order, weights=weights, gain=1.5)
+
+
+# ---------------------------------------------------------------------------
+# bit-identity: reordered + unequal-segment walks vs the naive ring
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("np_", [2, 3, 4])
+def test_reordered_weighted_walks_bit_identical(np_, clusters, monkeypatch):
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    cluster = clusters(np_)
+    rng = np.random.default_rng(42 + np_)
+    sizes = [1, np_ - 1, np_ + 1, 1000, 1001, 4 * np_ + 3]
+    cases = [(s, dt) for s in sizes for dt in (np.float32, np.int32)]
+    inputs = {
+        (ci, r): rng.integers(-8, 9, s).astype(dt)
+        for ci, (s, dt) in enumerate(cases)
+        for r in range(np_)
+    }
+    want = {
+        ci: sum(inputs[(ci, r)] for r in range(np_))
+        for ci in range(len(cases))
+    }
+    for tag, plan in (
+        ("naive", None),
+        ("reorder", _test_plan(np_, weighted=False)),
+        ("weighted", _test_plan(np_, weighted=True)),
+    ):
+        sessions = _sessions(cluster)
+        for s in sessions:
+            s._ring_plan = plan
+
+        def run(r, sess):
+            for ci, (size, dt) in enumerate(cases):
+                x = inputs[(ci, r)]
+                out = np.empty_like(x)
+                sess.all_reduce(Workspace(
+                    send=x, recv=out, op=ReduceOp.SUM,
+                    name=f"rpl:{np_}:{tag}:{ci}",
+                ))
+                np.testing.assert_array_equal(
+                    out, want[ci],
+                    err_msg=f"case {ci} ({size}, {dt}) plan={tag} rank={r}",
+                )
+
+        _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+
+
+@pytest.mark.parametrize("np_", [2, 3, 4])
+def test_rs_ag_under_plan_match_allreduce(np_, clusters):
+    """reduce_scatter returns the PLAN's owned bounds, the shards tile
+    the payload, and rs + all_gather_shards reassembles the allreduce
+    result bit for bit under a reordered, weighted plan."""
+    cluster = clusters(np_)
+    plan = _test_plan(np_, weighted=True, seed=3)
+    sessions = _sessions(cluster)
+    for s in sessions:
+        s._ring_plan = plan
+    rng = np.random.default_rng(77 + np_)
+    sizes = [1, np_ - 1, 1001]
+    inputs = {
+        (si, r): rng.integers(-8, 9, s).astype(np.float32)
+        for si, s in enumerate(sizes)
+        for r in range(np_)
+    }
+    want = {
+        si: sum(inputs[(si, r)] for r in range(np_))
+        for si in range(len(sizes))
+    }
+    seen_bounds = {}
+
+    def run(r, sess):
+        for si, s in enumerate(sizes):
+            x = inputs[(si, r)]
+            out = np.empty_like(x)
+            b, e = sess.reduce_scatter(Workspace(
+                send=x, recv=out, op=ReduceOp.SUM,
+                name=f"rplrs:{np_}:{si}",
+            ))
+            assert (b, e) == topo.owned_segment_bounds(
+                s, np_, r, order=plan.order, weights=plan.weights
+            )
+            np.testing.assert_array_equal(out[b:e], want[si][b:e])
+            seen_bounds[(si, r)] = (b, e)
+            full = np.zeros_like(x)
+            full[b:e] = out[b:e]
+            sess.all_gather_shards(full, f"rplag:{np_}:{si}")
+            np.testing.assert_array_equal(full, want[si])
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+    for si, s in enumerate(sizes):
+        covered = sorted(seen_bounds[(si, r)] for r in range(np_))
+        pos = 0
+        for b, e in covered:
+            assert b == pos
+            pos = e
+        assert pos == s
+
+
+# ---------------------------------------------------------------------------
+# the lockstep re-plan round (vote -> exchange -> derive -> adopt)
+# ---------------------------------------------------------------------------
+
+def _crafted_matrix(k):
+    m = _uniform(k, 200.0)
+    m[1, 2 % k] = 1.0
+    return m
+
+
+def test_check_replan_vote_and_adopt(clusters):
+    np_ = 3
+    cluster = clusters(np_)
+    sessions = _sessions(cluster)
+    m = _crafted_matrix(np_)
+    for s in sessions:
+        s.replan_mode = "auto"
+        s.measured_matrix = lambda m=m: m.copy()
+
+    # no majority: nothing happens, every peer stays naive
+    results = {}
+    _run_on_all([
+        lambda r=r, s=s: results.__setitem__(
+            r, s.check_replan(want=False)
+        )
+        for r, s in enumerate(sessions)
+    ])
+    assert all(v is None for v in results.values())
+    assert all(s.ring_plan() is None for s in sessions)
+
+    # majority (2 of 3): identical adoption everywhere
+    _run_on_all([
+        lambda r=r, s=s: results.__setitem__(
+            r, s.check_replan(want=r < 2, min_gain=1.0)
+        )
+        for r, s in enumerate(sessions)
+    ])
+    plans = [results[r] for r in range(np_)]
+    assert all(p is not None for p in plans)
+    assert len({p.to_bytes() for p in plans}) == 1
+    assert all(s.ring_plan() is not None for s in sessions)
+    order = sessions[0].ring_plan().order
+    edges = {(order[i], order[(i + 1) % np_]) for i in range(np_)}
+    assert (1, 2 % np_) not in edges  # routed around the slow edge
+    # the audit trail names the adoption
+    events = [r for r in taudit.to_json() if r.get("kind") == "topology_replanned"]
+    assert len(events) >= np_
+    ev = events[-1]
+    assert ev["detail"]["new_order"] == list(order)
+    assert ev["detail"]["predicted_gain"] > 1.0
+
+    # walks still exact under the adopted plan (payload above
+    # SEGMENT_MIN_BYTES so the REORDERED segmented ring actually runs)
+    def run(r, sess):
+        n = 20000
+        x = np.full(n, r + 1, np.float32)
+        out = np.empty_like(x)
+        sess.all_reduce(Workspace(
+            send=x, recv=out, op=ReduceOp.SUM, name=f"postadopt:{np_}",
+        ))
+        np.testing.assert_array_equal(
+            out, np.full(n, sum(range(1, np_ + 1)), np.float32)
+        )
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+
+    # re-running with the same matrix: plan already optimal, no churn
+    _run_on_all([
+        lambda r=r, s=s: results.__setitem__(
+            r, s.check_replan(want=True, min_gain=1.0)
+        )
+        for r, s in enumerate(sessions)
+    ])
+    assert all(v is None for v in results.values())
+
+
+def test_check_replan_off_mode_is_local_noop(clusters):
+    """KF_CONFIG_REPLAN=off (the default): check_replan returns without
+    running ANY collective — a single un-paired call must not hang."""
+    cluster = clusters(2)
+    sessions = _sessions(cluster)
+    assert sessions[0].replan_mode == "off"
+    assert sessions[0].check_replan(want=True) is None  # alone, no hang
+
+
+def test_divergent_plan_is_named_error_not_hang(clusters):
+    """A peer whose matrix-fed derivation diverged (injected here by
+    feeding peers different matrices) gets a named RuntimeError from the
+    adoption digest on the knob-independent walk — never a rendezvous
+    hang inside a later walk."""
+    np_ = 2
+    cluster = clusters(np_)
+    sessions = _sessions(cluster)
+    for s in sessions:
+        s.replan_mode = "ring"
+    errs = {}
+
+    def run(r, sess):
+        # k=2 rings are rotation-invariant, so force divergence through
+        # adopt_replan directly: different weights = different plans
+        plan = rp.RingPlan(
+            order=(0, 1), weights=(0.3 + 0.2 * r, 0.7 - 0.2 * r),
+        )
+        try:
+            sess.adopt_replan(plan)
+        except RuntimeError as e:
+            errs[r] = str(e)
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)],
+                join=60)
+    assert set(errs) == {0, 1}
+    for msg in errs.values():
+        assert "re-plan diverged" in msg
+    assert all(s.ring_plan() is None for s in sessions)
+
+
+def test_replan_knob_in_engine_consensus(clusters):
+    """KF_CONFIG_REPLAN divergence fails fast with the knob named (the
+    KF701 contract: consensus-flagged knob <-> engine_knobs tuple)."""
+    cluster = clusters(2)
+    sessions = _sessions(cluster)
+    assert any(
+        k == "KF_CONFIG_REPLAN" for k, _ in sessions[0].engine_knobs()
+    )
+    sessions[1].replan_mode = "ring"  # diverge one peer's resolved mode
+    errs = {}
+
+    def run(r, sess):
+        try:
+            sess.check_knob_consensus()
+        except RuntimeError as e:
+            errs[r] = str(e)
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+    assert set(errs) == {0, 1}
+    assert all("KF_CONFIG_REPLAN" in m for m in errs.values())
+
+
+def test_segmented_fallback_audited_once_per_session(clusters):
+    """ISSUE 14 satellite: the by-design tree fallback under an active
+    RING_SEGMENTED is audited exactly once per session epoch (and the
+    wire label stays BINARY_TREE — PR 4's counter-purity rule)."""
+    cluster = clusters(2)
+    sessions = _sessions(cluster)  # RING_SEGMENTED
+    before = len([
+        r for r in taudit.to_json() if r.get("kind") == "segmented_fallback"
+    ])
+    # the DELIBERATE knob-independent star walks (session-start knob
+    # consensus, re-plan rounds) must NOT trip the fallback audit —
+    # review finding: they used to consume the once-per-epoch event
+    # before any user collective ran
+    _run_on_all([lambda s=s: s.check_knob_consensus() for s in sessions])
+    assert len([
+        r for r in taudit.to_json() if r.get("kind") == "segmented_fallback"
+    ]) == before
+
+    def run(r, sess):
+        for i in range(2):  # two small walks, ONE event per session
+            x = np.full(4, r + 1.0, np.float32)  # far below SEGMENT_MIN
+            out = np.empty_like(x)
+            sess.all_reduce(Workspace(
+                send=x, recv=out, op=ReduceOp.SUM, name=f"fb:{i}",
+            ))
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+    events = [
+        r for r in taudit.to_json() if r.get("kind") == "segmented_fallback"
+    ]
+    assert len(events) - before == len(sessions)
+    assert events[-1]["detail"]["wire_label"] == "BINARY_TREE"
+
+
+# ---------------------------------------------------------------------------
+# ZeRO-1: mid-training re-plan re-shards state exactly
+# ---------------------------------------------------------------------------
+
+def _make_params(k, seed):
+    rng = np.random.default_rng(seed)
+    return [
+        rng.integers(-8, 9, n).astype(np.float32)
+        for n in (300, 4 * k + 3, 65)
+    ]
+
+
+def _replicated_sgd(p0, grad_rounds, k, lr, momentum=0.0, bufs=None):
+    """The replicated reference; `bufs` lets a caller carry momentum
+    state across phases (a restored sharded session does)."""
+    ref = [p.copy() for p in p0]
+    if bufs is None:
+        bufs = [np.zeros(p.size, np.float32) for p in p0]
+    for grads in grad_rounds:
+        for i in range(len(ref)):
+            g = grads[0][i].astype(np.float32).copy()
+            for r in range(1, k):
+                g = g + grads[r][i]
+            g = g * np.float32(1.0 / k)
+            if momentum:
+                bufs[i] = np.float32(momentum) * bufs[i] + g
+                g = bufs[i]
+            ref[i] = ref[i] - np.float32(lr) * g
+    return ref, bufs
+
+
+@pytest.mark.parametrize("np_", [2, 3, 4])
+def test_zero_survives_midtraining_replan(np_, clusters):
+    """Run sharded SGD-with-momentum for 2 rounds, adopt a reordered +
+    weighted plan (the registered listener exports state under the old
+    layout and re-shards under the new), run 2 more rounds: the final
+    params are bit-identical to the replicated reference — the re-shard
+    moved every momentum/master element to its new owner exactly."""
+    cluster = clusters(np_)
+    sessions = _sessions(cluster)
+    lr, momentum = 0.1, 0.9
+    p0 = _make_params(np_, seed=50 + np_)
+    rng = np.random.default_rng(60 + np_)
+    rounds = [
+        [
+            [rng.integers(-8, 9, p.size).astype(np.float32) for p in p0]
+            for _ in range(np_)
+        ]
+        for _ in range(4)
+    ]
+    ref, _ = _replicated_sgd(p0, rounds, np_, lr, momentum)
+    plan = _test_plan(np_, weighted=True, seed=9)
+    zsessions = {}
+    params = {r: [p.copy() for p in p0] for r in range(np_)}
+
+    def build(r, sess):
+        zsessions[r] = ShardedUpdateSession(
+            params[r], ShardedSGD(lr, momentum=momentum),
+            name=f"rplz{np_}", session=sess,
+        )
+
+    _run_on_all([lambda r=r, s=s: build(r, s) for r, s in enumerate(sessions)])
+
+    def steps(r, lo, hi):
+        for i in range(lo, hi):
+            zsessions[r].step(rounds[i][r])
+
+    _run_on_all([lambda r=r: steps(r, 0, 2) for r in range(np_)])
+    # capture each rank's momentum state bounds before/after the flip
+    old_bounds = [zsessions[r]._buckets[0].ob for r in range(np_)]
+    _run_on_all([
+        lambda r=r, s=s: s.adopt_replan(plan)
+        for r, s in enumerate(sessions)
+    ])
+    for r, s in enumerate(sessions):
+        b = zsessions[r]._buckets[0]
+        assert (b.ob, b.oe) == s.owned_bounds(b.total)
+    assert any(
+        zsessions[r]._buckets[0].ob != old_bounds[r] for r in range(np_)
+    ), "plan flip should move at least one rank's shard"
+    _run_on_all([lambda r=r: steps(r, 2, 4) for r in range(np_)])
+    for r in range(np_):
+        for i, p in enumerate(params[r]):
+            np.testing.assert_array_equal(
+                p, ref[i], err_msg=f"rank {r} param {i} after replan"
+            )
+
+
+def test_zero_shrink_reshard_across_plan_flip(clusters):
+    """Grow/shrink + plan flip: state exported from a PLANNED k=4
+    session restores onto a k=2 session that adopts a DIFFERENT plan —
+    the blob is layout-free (full state), so each epoch re-slices by its
+    own plan and continues bit-exactly."""
+    cluster = clusters(4)
+    lr, momentum = 0.05, 0.8
+    p0 = _make_params(4, seed=99)
+    rng = np.random.default_rng(111)
+    rounds4 = [
+        [[rng.integers(-8, 9, p.size).astype(np.float32) for p in p0]
+         for _ in range(4)]
+        for _ in range(2)
+    ]
+    rounds2 = [
+        [[rng.integers(-8, 9, p.size).astype(np.float32) for p in p0]
+         for _ in range(2)]
+        for _ in range(2)
+    ]
+    # momentum CARRIES across the resize: the exported blob holds the
+    # k=4 phase's buffers and the restored session keeps integrating them
+    ref_mid, bufs_mid = _replicated_sgd(p0, rounds4, 4, lr, momentum)
+    ref, _ = _replicated_sgd(ref_mid, rounds2, 2, lr, momentum,
+                             bufs=bufs_mid)
+
+    sessions4 = _sessions(cluster)
+    plan4 = _test_plan(4, weighted=True, seed=21)
+    _run_on_all([
+        lambda s=s: s.adopt_replan(plan4) for s in sessions4
+    ])
+    z4 = {}
+    params4 = {r: [p.copy() for p in p0] for r in range(4)}
+
+    def build4(r, sess):
+        z4[r] = ShardedUpdateSession(
+            params4[r], ShardedSGD(lr, momentum=momentum),
+            name="shrinkz", session=sess,
+        )
+
+    _run_on_all([lambda r=r, s=s: build4(r, s) for r, s in enumerate(sessions4)])
+    _run_on_all([
+        lambda r=r: [z4[r].step(rounds4[i][r]) for i in range(2)]
+        for r in range(4)
+    ])
+    blobs = {}
+    _run_on_all([
+        lambda r=r: blobs.__setitem__(r, z4[r].export_state())
+        for r in range(4)
+    ])
+    assert len({b for b in blobs.values()}) == 1  # identical on every peer
+
+    sessions2 = _sessions(cluster, subset=2)
+    plan2 = rp.RingPlan(order=(0, 1), weights=(0.31, 0.69))
+    _run_on_all([lambda s=s: s.adopt_replan(plan2) for s in sessions2])
+    z2 = {}
+    params2 = {r: [p.copy() for p in ref_mid] for r in range(2)}
+
+    def build2(r, sess):
+        z2[r] = ShardedUpdateSession(
+            params2[r], ShardedSGD(lr, momentum=momentum),
+            name="shrinkz2", session=sess, restore_state=blobs[0],
+        )
+
+    _run_on_all([lambda r=r, s=s: build2(r, s) for r, s in enumerate(sessions2)])
+    _run_on_all([
+        lambda r=r: [z2[r].step(rounds2[i][r]) for i in range(2)]
+        for r in range(2)
+    ])
+    for r in range(2):
+        for i, p in enumerate(params2[r]):
+            np.testing.assert_array_equal(
+                p, ref[i], err_msg=f"rank {r} param {i} after shrink+flip"
+            )
+
+
+# ---------------------------------------------------------------------------
+# satellites: ReplanPolicy gating, aggregator ring merge, info links render
+# ---------------------------------------------------------------------------
+
+class _FakeReplanSession:
+    """Records check_replan calls; adopts on the first wanted round."""
+
+    def __init__(self, size=3):
+        self.size = size
+        self.calls = []
+
+    def check_replan(self, want=True, min_gain=1.05, tag=""):
+        self.calls.append(bool(want))
+        if want:
+            return rp.RingPlan(order=(0, 2, 1), gain=1.4)
+        return None
+
+
+def test_replan_policy_gates_and_votes():
+    from kungfu_tpu.policy import PolicyContext, ReplanPolicy
+
+    sess = _FakeReplanSession()
+    pol = ReplanPolicy(interval_steps=4, patience=2,
+                       session_supplier=lambda: sess)
+    ctx = PolicyContext(batch_size=1)
+    # steps 1..3: no collective round at all (lockstep interval gate)
+    for step in range(1, 4):
+        ctx.step = step
+        ctx.metrics["step/critical_edge"] = "b:2"
+        pol.after_step(ctx)
+    assert sess.calls == []
+    # step 4: interval hit, edge seen on 3 refreshes >= patience -> want
+    ctx.step = 4
+    pol.after_step(ctx)
+    assert sess.calls == [True]
+    assert ctx.metrics["replan/last_order"] == [0, 2, 1]
+    assert ctx.metrics["replan/predicted_gain"] == pytest.approx(1.4)
+    # adoption reset the watch window: next round votes no
+    ctx.step = 8
+    ctx.metrics.pop("step/critical_edge")
+    pol.after_step(ctx)
+    assert sess.calls == [True, False]
+
+
+def test_replan_policy_debounces_on_cluster_refresh_marker():
+    from kungfu_tpu.policy import PolicyContext, ReplanPolicy
+
+    sess = _FakeReplanSession()
+    pol = ReplanPolicy(interval_steps=100, patience=3,
+                       session_supplier=lambda: sess)
+    ctx = PolicyContext(batch_size=1)
+    ctx.metrics["links/slowest_edge"] = ["a:1", "b:2"]
+    ctx.metrics["cluster/updated_at"] = 111.0
+    for step in range(1, 50):  # many steps, ONE refresh marker
+        ctx.step = step
+        pol.after_step(ctx)
+    assert pol._streak == 1  # counted once per refresh, not per step
+    ctx.metrics["cluster/updated_at"] = 222.0
+    ctx.step = 50
+    pol.after_step(ctx)
+    assert pol._streak == 2
+    # a different edge resets the streak
+    ctx.metrics["cluster/updated_at"] = 333.0
+    ctx.metrics["links/slowest_edge"] = ["a:1", "c:3"]
+    ctx.step = 51
+    pol.after_step(ctx)
+    assert pol._streak == 1
+
+
+def test_cluster_links_carries_active_ring():
+    """The aggregator reconstructs the ACTIVE ring from each worker's
+    exported position/successor gauges; a peer without a position (mid
+    re-plan, failed scrape) withholds the order rather than publishing
+    a half-true ring."""
+    import pytest as _pytest
+
+    _pytest.importorskip("kungfu_tpu.telemetry.http")
+    from kungfu_tpu.telemetry import metrics as tmetrics_mod
+    from kungfu_tpu.telemetry import cluster as tcluster
+    from kungfu_tpu.telemetry.http import TelemetryServer
+
+    workers = []
+    try:
+        for i in range(3):
+            reg = tmetrics_mod.Registry()
+            server = TelemetryServer(0, host="127.0.0.1", registry=reg)
+            server.start()
+            workers.append((reg, server, f"127.0.0.1:{server.port}",
+                            f"http://127.0.0.1:{server.port}"))
+        labels = [w[2] for w in workers]
+        # ring order 0 -> 2 -> 1 (re-planned): positions 0, 2, 1
+        ring_pos = [0, 2, 1]
+        succ = {0: labels[2], 2: labels[1], 1: labels[0]}
+        for i, (reg, _, label, _) in enumerate(workers):
+            reg.gauge(
+                "kungfu_topology_ring_position", "pos"
+            ).set(ring_pos[i])
+            reg.gauge(
+                "kungfu_topology_ring_next", "next", ("dst",)
+            ).labels(succ[ring_pos[i]]).set(1)
+        agg = tcluster.TelemetryAggregator(
+            interval=0.1, registry=tmetrics_mod.Registry()
+        )
+        agg.set_peers([(w[2], w[3]) for w in workers])
+        try:
+            agg.scrape_once()
+            ring = agg.cluster_links()["ring"]
+            assert ring["order"] == [labels[0], labels[2], labels[1]]
+            assert ring["position"] == {
+                labels[0]: 0, labels[1]: 2, labels[2]: 1,
+            }
+            assert ring["next"][labels[0]] == labels[2]
+            # lose one peer's exposition: the order is withheld
+            workers[1][1].stop()
+            agg.scrape_once()
+            ring = agg.cluster_links()["ring"]
+            assert ring["order"] is None
+            assert labels[1] not in ring["position"]
+        finally:
+            agg.stop()
+    finally:
+        for _, server, _, _ in workers:
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+
+
+def test_info_links_renders_ring_lines():
+    from kungfu_tpu.info.__main__ import render_links
+
+    peers = ["a:1", "b:2", "c:3"]
+    fast, slow = 200.0 * (1 << 20), 1.0 * (1 << 20)
+    edges = {
+        s: {
+            d: {"bw": (slow if (s, d) == ("b:2", "c:3") else fast)}
+            for d in peers if d != s
+        }
+        for s in peers
+    }
+    doc = {
+        "peers": peers, "edges": edges,
+        "min_bw": slow, "slowest_edge": ["b:2", "c:3"],
+        "ring": {"order": ["a:1", "c:3", "b:2"],
+                 "position": {}, "next": {}},
+    }
+    out = render_links(doc)
+    assert "active ring:    [0]→[2]→[1] ★ re-planned" in out
+    # the optimizer routes around b->c: predicted ring avoids that edge
+    assert "predicted ring:" in out
+    pred = next(l for l in out.splitlines() if "predicted ring" in l)
+    assert "[1]→[2]" not in pred
+    # rank-order active ring renders unstarred
+    doc["ring"]["order"] = list(peers)
+    out = render_links(doc)
+    assert "active ring:    [0]→[1]→[2] (rank order)" in out
+    # no ring block at all: matrix still renders
+    doc.pop("ring")
+    assert "predicted ring:" in render_links(doc)

@@ -1,11 +1,13 @@
 """Step-plane e2e (ISSUE 13 acceptance): a real np=4 run under
-`kfrun -w -debug-port` with an injected slow edge (KF_TEST_SLOW_EDGE
-delays one peer's sends toward its ring successor) serves merged
-per-step critical-path records on /cluster/steps that NAME that (peer,
-edge) within a few steps, `info steps` renders the lanes, and
-/cluster/health carries the compact steps summary the info-top columns
-read. The agents assert the worker-side plane (recorded timelines,
-step/* PolicyContext signals) themselves and exit nonzero otherwise."""
+`kfrun -w -debug-port` with a shaped slow edge (KF_SHAPE_LINKS — the
+ISSUE 14 shaped-link harness — delays one peer's sends toward its ring
+successor) serves merged per-step critical-path records on
+/cluster/steps that NAME that (peer, edge) within a few steps, `info
+steps` renders the lanes, and /cluster/health carries the compact steps
+summary the info-top columns read. The agents assert the worker-side
+plane (recorded timelines, step/* PolicyContext signals) themselves and
+exit nonzero otherwise. (Migrated off the deprecated KF_TEST_SLOW_EDGE
+alias, whose parse-compat is covered by tests/test_shaping.py.)"""
 
 import json
 import os
@@ -66,7 +68,7 @@ def test_np4_steps_end_to_end(tmp_path):
     env["KF_CONFIG_ASYNC"] = "on"
     env["KF_CONFIG_ALGO"] = "segmented"  # deterministic ring successor
     env["KF_CLUSTER_SCRAPE_INTERVAL"] = "0.5"
-    env["KF_TEST_SLOW_EDGE"] = f"{SLOW_SRC}>{SLOW_DST}=30"
+    env["KF_SHAPE_LINKS"] = f"{SLOW_SRC}>{SLOW_DST}=lat:30"
     env["KF_TEST_DONE_FILE"] = done_file
     proc = subprocess.Popen(
         [
