@@ -428,7 +428,8 @@ def bench_host_zero_ab(model: str, iters: int) -> None:
     )
 
 
-def bench_host_replan_ab(model: str, iters: int, warmup: int = 4) -> None:
+def bench_host_replan_ab(model: str, iters: int, warmup: int = 4,
+                         decisions: bool = False) -> None:
     """Paired same-process measured-topology A/B (ISSUE 14), two legs.
 
     **Ring order** — run under the harness's ``KF_SHAPE_LINKS`` shape
@@ -445,7 +446,13 @@ def bench_host_replan_ab(model: str, iters: int, warmup: int = 4) -> None:
     segments with throughput-weighted ones derived from the MEASURED
     per-peer update speed (exchanged over the ring, fed through
     ``replan.weights_from_throughput`` — the same clamp/normalize the
-    vote path uses), reporting per-leg step medians and the ratio."""
+    vote path uses), reporting per-leg step medians and the ratio.
+
+    ``decisions`` (ISSUE 15): feed the decision ledger the same timed
+    rounds — baseline rounds before the vote, measured-leg rounds after
+    — so the ``topology_replanned`` decision the adoption opens closes
+    with a ledger-measured realized gain, reported as DECISIONS lines
+    next to the paired-A/B headline it must agree with."""
     from kungfu_tpu import api
     from kungfu_tpu.base.ops import ReduceOp
     from kungfu_tpu.base.workspace import Workspace
@@ -476,6 +483,17 @@ def bench_host_replan_ab(model: str, iters: int, warmup: int = 4) -> None:
                 probe, root=root, name=f"replan:probe:{sweep}:{root}"
             )
     api.run_barrier()
+    ledger = None
+    if decisions:
+        from kungfu_tpu.telemetry import decisions as tdec
+
+        ledger = tdec.get_ledger()
+        # baseline rounds on the naive ring: the step history the
+        # adoption's decision record snapshots as its BEFORE window
+        for i in range(ledger.window + 1):
+            t0 = time.perf_counter()
+            api.group_all_reduce_arrays(grads, name=f"dbase:{i}", outs=outs)
+            ledger.note_step(time.perf_counter() - t0)
     # one production re-plan round: every peer votes yes (the bench IS
     # the standing bottleneck signal), rows are exchanged, the plan is
     # derived and digest-assert adopted
@@ -503,9 +521,14 @@ def bench_host_replan_ab(model: str, iters: int, warmup: int = 4) -> None:
         for i in range(per):
             t0 = time.perf_counter()
             api.group_all_reduce_arrays(grads, name=f"ab:{rnd}:{i}", outs=outs)
-            legs[mode].append(
-                total_bytes / (time.perf_counter() - t0) / (1 << 30)
-            )
+            dt = time.perf_counter() - t0
+            legs[mode].append(total_bytes / dt / (1 << 30))
+            if ledger is not None and mode == "measured":
+                # only the post-flip configuration's rounds feed the
+                # decision's AFTER window — the interleaved naive
+                # rounds are the A/B's control leg, not the adopted
+                # plan's steady state
+                ledger.note_step(dt)
     sess._ring_plan = None
     api.run_barrier()
     if api.current_rank() == 0:
@@ -524,6 +547,36 @@ def bench_host_replan_ab(model: str, iters: int, warmup: int = 4) -> None:
                 f"{meds['measured'] / meds['naive']:.2f}x "
                 f"[interleaved paired, {model}, shaped]"
             )
+        if ledger is not None:
+            recs = [r.to_json() for r in ledger.records()]
+            for rec in recs:
+                log.echo(
+                    f"DECISIONS {model}: {rec.get('kind')} "
+                    f"[{rec.get('trigger', '')}] predicted "
+                    + (
+                        f"{rec['predicted_gain']:.2f}x"
+                        if rec.get("predicted_gain") is not None else "—"
+                    )
+                    + " realized "
+                    + (
+                        f"{rec['realized_gain']:.2f}x"
+                        if rec.get("realized_gain") is not None else "—"
+                    )
+                    + f" verdict {rec.get('verdict') or rec.get('status')}"
+                )
+            closed = [
+                r for r in recs
+                if r.get("kind") == "topology_replanned"
+                and r.get("realized_gain")
+            ]
+            if closed and plan is not None and meds["naive"] > 0:
+                ab = meds["measured"] / meds["naive"]
+                rg = closed[-1]["realized_gain"]
+                log.echo(
+                    f"DECISIONS {model}: ledger realized {rg:.2f}x vs "
+                    f"paired-A/B {ab:.2f}x — agreement "
+                    f"{abs(rg / ab - 1):.0%} (acceptance 15%)"
+                )
 
     # ---- weighted segments vs equal, compute-shaped peer -------------
     # BOTH legs run the measured ring ORDER (when one was adopted), so
@@ -882,6 +935,14 @@ def main() -> None:
         "session comes up)",
     )
     p.add_argument(
+        "--decisions", action="store_true", dest="decisions_report",
+        help="HOST --replan only: feed the decision ledger (ISSUE 15) "
+        "the same timed rounds the A/B measures and append DECISIONS "
+        "report lines per adaptation (kind, predicted, realized, "
+        "verdict) — the ledger-measured realized gain must agree with "
+        "the paired-A/B headline within 15%%",
+    )
+    p.add_argument(
         "--async", action="store_true", dest="async_ab",
         help="HOST only: paired same-process async-scheduler A/B — "
         "alternate the serial step loop (compute all, then one step-end "
@@ -903,6 +964,9 @@ def main() -> None:
                        args.replan_ab) if f) > 1:
         p.error("--wire-ab/--async/--zero/--replan are separate A/Bs — "
                 "pick one")
+    if args.decisions_report and not args.replan_ab:
+        p.error("--decisions rides the --replan A/B (the adaptation it "
+                "closes with an outcome is the re-plan adoption)")
     if args.method == "HOST":
         import os
 
@@ -921,6 +985,11 @@ def main() -> None:
             # cluster-agreed like --algo
             os.environ["KF_CONFIG_ALGO"] = "segmented"
             os.environ["KF_CONFIG_REPLAN"] = "auto"
+        if args.decisions_report:
+            # size the ledger's windows to the A/B's round structure
+            # (per-leg rounds are few); an operator-set env still wins
+            os.environ.setdefault("KF_DECISION_WINDOW", "6")
+            os.environ.setdefault("KF_DECISION_SETTLE", "1")
         # wire-byte accounting rides the metrics gate; the bench wants it
         # on regardless so the A/B always reports bytes per peer
         from kungfu_tpu.telemetry import config as tconfig
@@ -939,7 +1008,8 @@ def main() -> None:
     elif args.zero_ab:
         bench_host_zero_ab(args.model, args.iters)
     elif args.replan_ab:
-        bench_host_replan_ab(args.model, args.iters)
+        bench_host_replan_ab(args.model, args.iters,
+                             decisions=args.decisions_report)
     else:
         bench_host(args.model, args.iters)
     if args.method == "HOST" and args.steps_report:

@@ -504,6 +504,20 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             new_wire=new_wire,
             switch_count=self.adaptive.switch_count,
         )
+        # decision ledger (ISSUE 15): open the causal record the moment
+        # the switch lands — the paired step windows around this point
+        # close it with a realized gain and verdict
+        from kungfu_tpu.telemetry import decisions as _decisions
+
+        _decisions.open_decision(
+            "strategy_switch",
+            peer=str(self.self_id),
+            epoch=self.cluster_version,
+            trigger="interference_vote",
+            signals={"votes": int(votes_out[0]), "size": self.size},
+            old=f"{old_strategy.name}/{old_wire}",
+            new=f"{new_strategy.name}/{new_wire}",
+        )
         return True
 
     def active_strategy(self) -> Optional[Strategy]:
@@ -735,6 +749,26 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             ),
             weighted=bool(plan is not None and plan.weights is not None),
             predicted_gain=plan.gain if plan is not None else 1.0,
+        )
+        # decision ledger (ISSUE 15): the re-plan predicted a throughput
+        # ratio — this record is what finally measures the realized one
+        from kungfu_tpu.telemetry import decisions as _decisions
+
+        _decisions.open_decision(
+            "topology_replanned",
+            peer=str(self.self_id),
+            epoch=self.cluster_version,
+            trigger="replan_vote",
+            predicted_gain=plan.gain if plan is not None else 1.0,
+            old_order=",".join(
+                str(r) for r in (old.order if old is not None
+                                 else range(self.size))
+            ),
+            new_order=",".join(
+                str(r) for r in (plan.order if plan is not None
+                                 else range(self.size))
+            ),
+            weighted=bool(plan is not None and plan.weights is not None),
         )
 
     def _publish_ring_metrics(self) -> None:

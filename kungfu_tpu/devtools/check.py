@@ -12,6 +12,8 @@ set ONCE (per-file cache and all) and sections the report by concern:
 - ``[metric-docs]``  docs/telemetry.md vs registered families (KF600/601)
 - ``[span-docs]``    docs/telemetry.md's span table vs emitted span
   kinds (KF602, ISSUE 13 satellite)
+- ``[audit-docs]``   docs/telemetry.md's audit event table vs recorded
+  audit kinds (KF604, ISSUE 15 satellite)
 
 Exit status is the contract — 0 clean, 1 findings — matching the
 kfcheck CLI. ``tests/test_kfcheck.py`` invokes it as the tier-1 gate;
@@ -31,6 +33,7 @@ from kungfu_tpu.devtools.kfcheck import core
 _DOC_RULES_KNOBS = ("KF102",)
 _DOC_RULES_METRICS = ("KF600", "KF601")
 _DOC_RULES_SPANS = ("KF602",)
+_DOC_RULES_AUDIT = ("KF604",)
 
 
 def _section(findings: List["core.Finding"], title: str, rules) -> List[str]:
@@ -54,7 +57,7 @@ def main(argv=None) -> int:
     findings = core.run_project(use_cache=not args.no_cache)
     doc_rules = (
         set(_DOC_RULES_KNOBS) | set(_DOC_RULES_METRICS)
-        | set(_DOC_RULES_SPANS)
+        | set(_DOC_RULES_SPANS) | set(_DOC_RULES_AUDIT)
     )
     code = [f for f in findings if f.rule not in doc_rules]
     out: List[str] = []
@@ -62,6 +65,7 @@ def main(argv=None) -> int:
     out.extend(_section(findings, "knobs-doc", _DOC_RULES_KNOBS))
     out.extend(_section(findings, "metric-docs", _DOC_RULES_METRICS))
     out.extend(_section(findings, "span-docs", _DOC_RULES_SPANS))
+    out.extend(_section(findings, "audit-docs", _DOC_RULES_AUDIT))
     n = len(findings)
     out.append(
         "check: clean" if n == 0

@@ -1030,6 +1030,125 @@ def check_spans_documented(project: Project) -> List[Finding]:
     return out
 
 
+# KF604 — audit-kind doc lint (ISSUE 15 satellite): the audit-event
+# shape of KF600/602 in one bidirectional rule. Every event-kind
+# LITERAL passed to telemetry.audit.record_event(...) must appear in
+# docs/telemetry.md's audit event table, and every table row must still
+# exist in code. record_resize() emits kind="resize" without a literal
+# at its call sites, so "resize" is seeded whenever a call exists;
+# kinds passed through a parameter indirection (lockwatch's reporter
+# queue) are declared in _AUDIT_INDIRECT so the scan stays honest about
+# its blind spot.
+
+_AUDIT_MODULES = frozenset({"audit", "_audit"})
+_AUDIT_INDIRECT = frozenset({
+    # lockwatch._report enqueues (kind, counter, detail); _emit forwards
+    # the kind parameter to audit.record_event
+    "lock_order_violation",
+    "lock_long_held",
+})
+
+_AUDIT_TABLE_HEADING = "## Audit event table"
+
+
+def _source_audit_kinds(project: Project) -> Set[str]:
+    kinds: Set[str] = set()
+    for ctx in project.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.walk():
+            if not isinstance(node, ast.Call):
+                continue
+            fn = node.func
+            if _last_segment(fn) == "record_resize":
+                kinds.add("resize")
+                continue
+            if not (
+                isinstance(fn, ast.Attribute)
+                and fn.attr == "record_event"
+                and _last_segment(fn.value) in _AUDIT_MODULES
+            ):
+                continue
+            if (
+                node.args
+                and isinstance(node.args[0], ast.Constant)
+                and isinstance(node.args[0].value, str)
+            ):
+                kinds.add(node.args[0].value)
+    return kinds
+
+
+def _audit_table_rows(project: Project) -> Optional[List[Tuple[int, str]]]:
+    """(lineno, event kind) per row of docs/telemetry.md's audit event
+    table, or None when the doc/heading is missing."""
+    got = _telemetry_doc(project)
+    if got is None:
+        return None
+    _, lines = got
+    rows: List[Tuple[int, str]] = []
+    in_table = False
+    for i, line in enumerate(lines, start=1):
+        if line.strip() == _AUDIT_TABLE_HEADING:
+            in_table = True
+            continue
+        if in_table and line.startswith("## "):
+            break
+        if in_table and line.startswith("| `"):
+            for name in re.findall(r"`([a-z0-9_]+)`", line.split("|")[1]):
+                rows.append((i, name))
+    return rows if in_table else None
+
+
+@rule(
+    "KF604",
+    "audit-doc-lint",
+    "every audit-event kind recorded through telemetry.audit must "
+    "appear in docs/telemetry.md's audit event table AND every table "
+    "row must still exist in code — the audit log is the operator's "
+    "'what changed and when' surface, and an undocumented kind (or a "
+    "stale row) misleads exactly the 3am reader it exists for (the "
+    "KF600/602 contract, for audit events)",
+    scope="project",
+)
+def check_audit_kinds_documented(project: Project) -> List[Finding]:
+    kinds = _source_audit_kinds(project) | _AUDIT_INDIRECT
+    out: List[Finding] = []
+    if len(kinds) <= 8:
+        # the scan must keep finding the recorder call sites — a rename
+        # must not silently turn this rule into a no-op
+        out.append(Finding(
+            "KF604", "docs/telemetry.md", 1,
+            f"audit-kind scan found only {len(kinds)} kinds — the AST "
+            "scan looks broken (record_event rename?), fix the rule "
+            "before trusting it",
+        ))
+        return out
+    rows = _audit_table_rows(project)
+    if rows is None:
+        return [Finding(
+            "KF604", "docs/telemetry.md", 1,
+            f"docs/telemetry.md has no `{_AUDIT_TABLE_HEADING}` section "
+            "— add the audit event table (one row per event kind)",
+        )]
+    documented = {name for _, name in rows}
+    for name in sorted(kinds - documented):
+        out.append(Finding(
+            "KF604", "docs/telemetry.md", 1,
+            f"audit event kind {name!r} is recorded in the package but "
+            "absent from docs/telemetry.md's audit event table — add a "
+            "row",
+        ))
+    for lineno, name in rows:
+        if name not in kinds:
+            out.append(Finding(
+                "KF604", "docs/telemetry.md", lineno,
+                f"docs/telemetry.md's audit event table documents "
+                f"{name!r} but no code records it — drop the stale row "
+                "(parameter-indirected kinds belong in _AUDIT_INDIRECT)",
+            ))
+    return out
+
+
 # ---------------------------------------------------------------------
 # KF7xx — distributed protocol (ISSUE 12: the first cross-module rules)
 # ---------------------------------------------------------------------

@@ -1,7 +1,8 @@
 """Usage: python3 -m kungfu_tpu.info [--no-devices] [--telemetry [URL]]
-       python3 -m kungfu_tpu.info top [--watch] [--interval S] [URL]
-       python3 -m kungfu_tpu.info links [--watch] [--interval S] [URL]
-       python3 -m kungfu_tpu.info steps [--watch] [--interval S] [-n N] [URL]
+       python3 -m kungfu_tpu.info top [--watch] [--json] [--interval S] [URL]
+       python3 -m kungfu_tpu.info links [--watch] [--json] [--interval S] [URL]
+       python3 -m kungfu_tpu.info steps [--watch] [--json] [--interval S] [-n N] [URL]
+       python3 -m kungfu_tpu.info decisions [--watch] [--json] [--interval S] [-n N] [URL]
        python3 -m kungfu_tpu.info postmortem [DIR|URL]
 
 Prints framework, backend and cluster-env diagnostics (parity:
@@ -32,6 +33,18 @@ steps from the runner's /cluster/steps endpoint as aligned per-peer
 lanes, the critical (peer, bucket, edge) chain highlighted with `*`,
 plus each step's overlap and queue-delay fractions. This is the "why
 is this step slow?" view — see the runbook in docs/telemetry.md.
+
+`decisions` renders the decision ledger (ISSUE 15): the cluster's
+merged causal adaptation timeline from the runner's /cluster/decisions
+endpoint — every strategy/wire vote, measured re-plan, engine-mode flip
+and elastic resize with its trigger, predicted gain and MEASURED
+outcome (realized gain, delivered/neutral/regressed verdict, regression
+watchdog flag). This is the "the cluster adapted — did it help?" view —
+see the runbook in docs/telemetry.md.
+
+`--json` (top/links/steps/decisions) emits the raw cluster endpoint
+payload instead of the rendered table — one flag for scripting/CI,
+applied in the shared fetch loop.
 
 `postmortem` reconstructs the death timeline of crashed workers
 (ISSUE 3): point it at a telemetry run dir (KF_TELEMETRY_DIR, default
@@ -155,12 +168,35 @@ def _cluster_url(argv, endpoint: str) -> str:
     return url
 
 
+def _count_flag(argv, cmd: str, default: int):
+    """Parse `-n COUNT` (shared by steps/decisions); (None, rc) on bad
+    input — the _interval_flag shape."""
+    if "-n" not in argv:
+        return default, None
+    idx = argv.index("-n")
+    try:
+        return max(1, int(argv[idx + 1])), None
+    except (IndexError, ValueError):
+        print(f"info {cmd}: -n wants a count, e.g. -n 8", file=sys.stderr)
+        return None, 2
+
+
+def _json_flag(argv, render):
+    """The --json satellite (ISSUE 15): one flag in one place — every
+    cluster subcommand swaps its renderer for a raw-payload dump when
+    --json is passed, so scripts/CI read the endpoint document through
+    the same URL resolution and fetch loop the human view uses."""
+    if "--json" not in argv:
+        return render
+    return lambda doc: json.dumps(doc, indent=2)
+
+
 def _fetch_render_loop(cmd: str, url: str, render, watch: bool,
                        interval: float) -> int:
     """The shared fetch-JSON → render → print/refresh loop behind the
-    one-shot and --watch modes of top/links/steps. Watch mode rides out
-    transient fetch blips (runner mid-restart) instead of killing the
-    live view; the whole iteration is interruptible."""
+    one-shot and --watch modes of top/links/steps/decisions. Watch mode
+    rides out transient fetch blips (runner mid-restart) instead of
+    killing the live view; the whole iteration is interruptible."""
     while True:
         try:
             try:
@@ -271,7 +307,9 @@ def _cmd_top(argv) -> int:
             file=sys.stderr,
         )
         return 2
-    return _fetch_render_loop("top", url, render_top, watch, interval)
+    return _fetch_render_loop(
+        "top", url, _json_flag(argv, render_top), watch, interval
+    )
 
 
 def render_links(doc: dict) -> str:
@@ -407,7 +445,9 @@ def _cmd_links(argv) -> int:
             file=sys.stderr,
         )
         return 2
-    return _fetch_render_loop("links", url, render_links, watch, interval)
+    return _fetch_render_loop(
+        "links", url, _json_flag(argv, render_links), watch, interval
+    )
 
 
 def render_steps(doc: dict, limit: int = 8) -> str:
@@ -450,15 +490,9 @@ def _cmd_steps(argv) -> int:
     interval, rc = _interval_flag(argv, "steps")
     if rc is not None:
         return rc
-    limit = 8
-    if "-n" in argv:
-        idx = argv.index("-n")
-        try:
-            limit = max(1, int(argv[idx + 1]))
-        except (IndexError, ValueError):
-            print("info steps: -n wants a step count, e.g. -n 4",
-                  file=sys.stderr)
-            return 2
+    limit, rc = _count_flag(argv, "steps", 8)
+    if rc is not None:
+        return rc
     url = _cluster_url(argv, "/cluster/steps")
     if not url:
         print(
@@ -469,7 +503,34 @@ def _cmd_steps(argv) -> int:
         )
         return 2
     return _fetch_render_loop(
-        "steps", url, lambda doc: render_steps(doc, limit=limit),
+        "steps", url,
+        _json_flag(argv, lambda doc: render_steps(doc, limit=limit)),
+        watch, interval,
+    )
+
+
+def _cmd_decisions(argv) -> int:
+    watch = "--watch" in argv
+    interval, rc = _interval_flag(argv, "decisions")
+    if rc is not None:
+        return rc
+    limit, rc = _count_flag(argv, "decisions", 16)
+    if rc is not None:
+        return rc
+    url = _cluster_url(argv, "/cluster/decisions")
+    if not url:
+        print(
+            "info decisions: no /cluster/decisions URL — pass one (or a "
+            "runner debug endpoint), or run under kfrun -w -debug-port N "
+            "(which exports KF_CLUSTER_HEALTH_URL)",
+            file=sys.stderr,
+        )
+        return 2
+    from kungfu_tpu.telemetry import decisions as _dec
+
+    return _fetch_render_loop(
+        "decisions", url,
+        _json_flag(argv, lambda doc: _dec.render_decisions(doc, limit=limit)),
         watch, interval,
     )
 
@@ -523,6 +584,8 @@ def main(argv) -> None:
         sys.exit(_cmd_links(argv[1:]))
     if argv and argv[0] == "steps":
         sys.exit(_cmd_steps(argv[1:]))
+    if argv and argv[0] == "decisions":
+        sys.exit(_cmd_decisions(argv[1:]))
     if argv and argv[0] == "postmortem":
         sys.exit(_cmd_postmortem(argv[1:]))
     _show_versions()

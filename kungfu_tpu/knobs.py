@@ -254,6 +254,37 @@ _knob("KF_STEP_TIMELINE_KEEP", "16", _int,
       "the step plane entirely.",
       section=_SEC_TELEMETRY, kind="int")
 
+_SEC_DECISION = "Decision ledger"
+_knob("KF_DECISION_KEEP", "64", _int,
+      "Decision-ledger ring size: how many adaptation decisions "
+      "(strategy/wire votes, re-plans, mode flips, resizes) each worker "
+      "keeps with their measured outcomes (served at /decisions, merged "
+      "into /cluster/decisions, journaled by the flight recorder). "
+      "0 disables the ledger entirely.",
+      section=_SEC_DECISION, kind="int")
+_knob("KF_DECISION_WINDOW", "8", _int,
+      "Paired measurement window: how many step durations form the "
+      "baseline captured at an adaptation and the post-settle window "
+      "that closes it with a realized gain (minimum 2).",
+      section=_SEC_DECISION, kind="int")
+_knob("KF_DECISION_SETTLE", "2", _int,
+      "Steps skipped after an adaptation before its outcome window "
+      "starts measuring (pools/caches/estimators re-warm under the new "
+      "configuration; counting those steps would bias every realized "
+      "gain low).",
+      section=_SEC_DECISION, kind="int")
+_knob("KF_DECISION_REGRESS_RATIO", "0.9", _float,
+      "Regression floor: a closed decision whose realized gain stays at "
+      "or under this ratio (baseline step time / post-flip step time) "
+      "for KF_DECISION_PATIENCE consecutive windows fires an "
+      "`adaptation_regressed` audit event — the rollback signal.",
+      section=_SEC_DECISION, kind="float")
+_knob("KF_DECISION_PATIENCE", "2", _int,
+      "Regression-watchdog patience: consecutive below-floor "
+      "measurement windows (the closing window counts as the first) "
+      "before `adaptation_regressed` fires.",
+      section=_SEC_DECISION, kind="int")
+
 _SEC_FLIGHT = "Flight recorder"
 _knob("KF_FLIGHT", "", _bool,
       "Explicit on/off override for the flight recorder; unset means "

@@ -192,6 +192,7 @@ class Peer:
         """Rebuild the session for a new peer list; returns False if self is
         not a member (detached). Parity: peer.updateTo (peer.go:148-170)."""
         with self._session_lock:
+            old_session = self._session
             if self._session is not None:
                 # session-epoch invalidation (ISSUE 10): the old epoch's
                 # async scheduler must drain or cancel its in-flight
@@ -218,6 +219,30 @@ class Peer:
             )
             self._peers = peers
             self.epoch_count += 1
+            # decision ledger (ISSUE 15): an engine-mode flip at a
+            # session epoch (KF_CONFIG_ASYNC / KF_CONFIG_ZERO resolving
+            # differently — env change under `reload`, or `auto`
+            # crossing the multi-peer threshold on a resize) is an
+            # adaptation like any vote: open its causal record so the
+            # paired step windows measure whether it helped
+            if old_session is not None:
+                from kungfu_tpu.telemetry import decisions as _decisions
+
+                for kind, was, now in (
+                    ("async_mode", old_session.async_enabled(),
+                     self._session.async_enabled()),
+                    ("zero_mode", old_session.zero_enabled(),
+                     self._session.zero_enabled()),
+                ):
+                    if was != now:
+                        _decisions.open_decision(
+                            kind,
+                            peer=str(self.self_id),
+                            epoch=self.cluster_version,
+                            trigger="session_epoch",
+                            old="on" if was else "off",
+                            new="on" if now else "off",
+                        )
             # link plane: drop estimators for departed destinations —
             # a shed peer's frozen bandwidth estimate must not keep
             # winning links/min_bw or walk-efficiency scoring (runners
@@ -325,6 +350,21 @@ class Peer:
             progress=progress or None,
             detached=not keep,
         )
+        if keep:
+            # decision ledger (ISSUE 15): the resize is the capacity
+            # decision ROADMAP item 4's autoscaler must trust — open the
+            # outcome record on every surviving peer (a detached peer
+            # has no post-flip steps to measure)
+            from kungfu_tpu.telemetry import decisions as _decisions
+
+            _decisions.open_decision(
+                "resize",
+                peer=str(self.self_id),
+                epoch=self.cluster_version,
+                trigger=trigger,
+                old_size=len(old_peers),
+                new_size=len(cluster.workers),
+            )
         log.info(
             "resize v%d: %d -> %d workers (%s)%s",
             self.cluster_version,

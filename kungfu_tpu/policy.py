@@ -30,6 +30,14 @@ needs every peer's timeline) and ``step/overlap_frac`` +
 step timelines, overridden by the cluster merge) — per-step measured
 attribution, the inputs ROADMAP items 2 (measured-topology re-planning)
 and 5 (profile-fed submit priorities) consume.
+
+The decision ledger (ISSUE 15) closes the adaptation loop on itself:
+``PolicyRunner.step()`` feeds each step's wall-clock duration to the
+ledger (the measurement substrate of every adaptation's realized gain)
+and ``decision/last_kind`` + ``decision/last_realized_gain`` +
+``decision/regressed`` surface the latest measured outcome — the trust
+signals an unattended autoscaler (ROADMAP item 4) needs before it can
+act without an operator.
 """
 
 from __future__ import annotations
@@ -129,13 +137,16 @@ class PolicyRunner:
             # — a frozen links/min_bw steering re-planning hours later
             # is the exact staleness LinkTable.prune exists to prevent
             from kungfu_tpu.collective.host_session import get_walk_profiler
+            from kungfu_tpu.telemetry import decisions as _tdec
             from kungfu_tpu.telemetry import link as _link
             from kungfu_tpu.telemetry import steptrace as _steptrace
 
             for key in ("links/min_bw", "links/slowest_edge",
                         "collective/efficiency", "collective/wait_frac",
                         "step/overlap_frac", "step/queue_delay_frac",
-                        "step/critical_peer", "step/critical_edge"):
+                        "step/critical_peer", "step/critical_edge",
+                        "decision/last_kind", "decision/last_realized_gain",
+                        "decision/regressed"):
                 self.ctx.metrics.pop(key, None)
             if _link.enabled():
                 self.ctx.metrics.update(_link.get_table().signals())
@@ -145,6 +156,9 @@ class PolicyRunner:
             # step/critical_peer + step/critical_edge) overrides these
             # below when the runner aggregator is live
             self.ctx.metrics.update(_steptrace.get_store().local_signals())
+            # decision ledger (ISSUE 15): the latest measured adaptation
+            # outcome, worker-local (decisions fire on every peer)
+            self.ctx.metrics.update(_tdec.get_ledger().signals())
         except Exception as e:  # noqa: BLE001 - telemetry must never kill training
             log.debug("policy: walk/link signal refresh failed: %s", e)
         try:
@@ -187,6 +201,13 @@ class PolicyRunner:
             if self._m_steps is not None:
                 self._m_steps.inc()
                 self._m_step_hist.observe(dt)
+            # decision ledger (ISSUE 15): the per-step durations are the
+            # measurement substrate every adaptation's realized gain is
+            # computed from — fire-and-forget, a deque append when no
+            # decision is measuring
+            from kungfu_tpu.telemetry import decisions as _tdec
+
+            _tdec.note_step(dt)
             self._pull_cluster_signals()
             self.ctx.trained_samples += self.ctx.batch_size
             self.ctx.step += 1

@@ -57,6 +57,7 @@ class DebugServer:
     - ``/cluster/health``  per-peer step rate / straggler JSON
     - ``/cluster/links``   k×k link matrix (per-edge bandwidth/latency)
     - ``/cluster/steps``   merged per-step critical-path records
+    - ``/cluster/decisions`` merged adaptation-decision ledger
     - anything else        the Stage/worker debug dump (old contract)
     """
 
@@ -84,6 +85,11 @@ class DebugServer:
             if path == "/cluster/steps":
                 return (
                     json.dumps(agg.cluster_steps(), indent=2),
+                    "application/json",
+                )
+            if path == "/cluster/decisions":
+                return (
+                    json.dumps(agg.cluster_decisions(), indent=2),
                     "application/json",
                 )
             if path == "/cluster/audit":

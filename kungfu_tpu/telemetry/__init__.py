@@ -64,9 +64,12 @@ __all__ = [
     "straggler",
     "flight",
     "steptrace",
+    "decisions",
 ]
 
-_LAZY_MODULES = ("cluster", "promparse", "straggler", "flight", "steptrace")
+_LAZY_MODULES = (
+    "cluster", "promparse", "straggler", "flight", "steptrace", "decisions",
+)
 
 
 def __getattr__(name):

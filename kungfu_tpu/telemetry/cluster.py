@@ -26,7 +26,13 @@ serves it from the watcher's debug endpoint:
   ``/steptrace`` ring merged per (session_epoch, round) with the same
   clock offsets, each step carrying its elected critical (peer, bucket,
   edge) chain, overlap fraction and queue-delay fraction — "which
-  bucket on which peer over which edge was the long pole" as data.
+  bucket on which peer over which edge was the long pole" as data;
+- ``/cluster/decisions`` — the decision plane (ISSUE 15): every
+  worker's ``/decisions`` ledger merged into one NTP-aligned causal
+  timeline — each adaptation (strategy/wire vote, re-plan, mode flip,
+  resize) with its trigger, predicted gain and MEASURED outcome
+  (realized gain, verdict, regression flag) — "the cluster adapted;
+  did it help?" as data.
 
 On top of the snapshot the aggregator runs straggler detection
 (:mod:`~kungfu_tpu.telemetry.straggler`): rolling per-peer step-time
@@ -52,6 +58,7 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import audit, log, metrics, promparse
+from kungfu_tpu.telemetry import decisions as tdecisions
 from kungfu_tpu.telemetry import link as tlink
 from kungfu_tpu.telemetry import steptrace as tstep
 from kungfu_tpu.telemetry import straggler as tstraggler
@@ -291,6 +298,19 @@ class TelemetryAggregator:
         # same _steps_last — duplicating steps and double-counting the
         # patience streak. NOT self._lock: a refresh spans HTTP fetches.
         self._steps_refresh_lock = threading.Lock()
+        # decision plane (ISSUE 15): every worker's /decisions ledger
+        # merged into one causal timeline, keyed (peer, seq, open wall
+        # time) so a later scrape of the SAME record (now closed, or
+        # regressed) updates it in place instead of duplicating it —
+        # while a RESPAWNED worker's fresh ledger (seq restarting at 0
+        # on the same label) cannot overwrite the dead incarnation's
+        # records: its records carry new open stamps. Bounded like a
+        # ring: oldest merged entries drop past KF_DECISION_KEEP.
+        self._decisions: Dict[Tuple[str, int, float], dict] = {}
+        self._decisions_at: Optional[float] = None  # monotonic
+        _dkeep = int(knobs.get("KF_DECISION_KEEP"))
+        self._decisions_keep = _dkeep if _dkeep > 0 else 64
+        self._decisions_refresh_lock = threading.Lock()
         self._g_step_overlap = reg.gauge(
             "kungfu_step_overlap_ratio",
             "Latest merged step's overlap fraction: scheduler-busy comm "
@@ -543,6 +563,10 @@ class TelemetryAggregator:
             self._refresh_steps()
         except Exception as e:  # noqa: BLE001 - the sweep must outlive a bad step merge
             log.warn("cluster: step-plane refresh failed: %s", e)
+        try:
+            self._refresh_decisions()
+        except Exception as e:  # noqa: BLE001 - the sweep must outlive a bad merge
+            log.warn("cluster: decision-plane refresh failed: %s", e)
         self._publish()
         return self.cluster_health()
 
@@ -925,6 +949,76 @@ class TelemetryAggregator:
             "count": len(steps),
             "patience": STEP_CRIT_PATIENCE,
             "steps": steps,
+        }
+
+    # -- decision plane (ISSUE 15) --------------------------------------
+
+    def _refresh_decisions(self) -> None:
+        """Pull every worker's /decisions ledger, align the perf stamps
+        with the clock offsets already estimated for /cluster/trace and
+        merge keyed (peer, seq, open wall time): re-scraping an
+        unchanged ledger is idempotent, a record that closed (or
+        regressed) since the last sweep UPDATES its merged copy in
+        place, and a respawned worker's restarted seq space cannot
+        collide with its dead incarnation's records. Whole refreshes
+        serialize like the step plane's."""
+        with self._decisions_refresh_lock:
+            self._refresh_decisions_locked()
+
+    def _refresh_decisions_locked(self) -> None:
+        docs: Dict[str, dict] = {}
+        offsets: Dict[str, float] = {}
+        for st, body in self._fetch_all("/decisions"):
+            try:
+                docs[st.label] = json.loads(body.decode())
+            except ValueError as e:
+                st.last_error = str(e)
+                continue
+            offsets[st.label] = st.clock_offset_us or 0.0
+        self._decisions_at = time.monotonic()
+        if not docs:
+            return
+        merged = tdecisions.merge_decisions(docs, offsets)
+        with self._lock:
+            for rec in merged:
+                self._decisions[(
+                    rec.get("peer", ""),
+                    int(rec.get("seq", 0)),
+                    float(rec.get("wall_time") or 0.0),
+                )] = rec
+            if len(self._decisions) > self._decisions_keep:
+                ordered = sorted(
+                    self._decisions.items(),
+                    key=lambda kv: kv[1].get("t_us") or 0.0,
+                )
+                for key, _ in ordered[:-self._decisions_keep]:
+                    del self._decisions[key]
+
+    def cluster_decisions(self) -> dict:
+        """The /cluster/decisions view: the merged causal adaptation
+        timeline, oldest first. Refreshes inline when the cached merge
+        is older than a scrape interval, so one-shot consumers (`info
+        decisions` without a runner loop) still see fresh outcomes."""
+        now = time.monotonic()
+        if (
+            self._decisions_at is None
+            or now - self._decisions_at >= self.interval
+        ):
+            try:
+                self._refresh_decisions()
+            except Exception as e:  # noqa: BLE001 - serve the cache over a 500
+                log.warn("cluster: inline decision refresh failed: %s", e)
+        with self._lock:
+            recs = sorted(
+                self._decisions.values(),
+                key=lambda r: r.get("t_us") or r.get("wall_time") or 0.0,
+            )
+        return {
+            "wall_time": time.time(),
+            "count": len(recs),
+            "open": sum(1 for r in recs if r.get("status") != "closed"),
+            "regressed": sum(1 for r in recs if r.get("regressed")),
+            "decisions": recs,
         }
 
     def _steps_summary(self) -> Optional[dict]:

@@ -43,7 +43,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
-from kungfu_tpu.telemetry import audit, log, metrics, steptrace, tracing
+from kungfu_tpu.telemetry import audit, decisions, log, metrics, steptrace, tracing
 from kungfu_tpu.telemetry.config import env_truthy, truthy
 
 DIR_ENV = "KF_TELEMETRY_DIR"
@@ -70,6 +70,7 @@ _FRAME = struct.Struct("<II")  # payload length, crc32(payload)
 SPAN_TAIL = 48
 AUDIT_TAIL = 32
 LOG_TAIL = 60
+DECISION_TAIL = 8
 
 
 def _env_float(name: str, default: float) -> float:
@@ -384,6 +385,10 @@ class FlightRecorder:
             # can say WHERE IN THE STEP the worker died (an unflushed
             # final timeline names the bucket that never finished)
             "steps": steptrace.get_store().timelines(),
+            # the decision ledger's tail (ISSUE 15): a postmortem can
+            # name the adaptation the cluster was mid-flip on at death
+            # (an unclosed decision with no outcome IS that answer)
+            "decisions": decisions.get_ledger().tail(DECISION_TAIL),
         }
         rec.update(extra)
         return rec
@@ -624,6 +629,7 @@ def harvest_postmortem(
         "last_step_timeline": (
             (last.get("steps") or [None])[-1] if last else None
         ),
+        "last_decisions": (last.get("decisions") or []) if last else [],
         "open_spans": (last.get("open_spans") or {}) if last else {},
         "audit_tail": (last.get("audit") or [])[-10:] if last else [],
         "log_tail": (last.get("log_tail") or [])[-20:] if last else [],
@@ -747,6 +753,20 @@ def render_postmortem(pm: dict) -> str:
         lines.extend(
             " " + l for l in steptrace.render_timeline(tl, peer=str(peer))
         )
+    last_dec = pm.get("last_decisions") or []
+    if last_dec:
+        lines.append("final adaptation decisions (ledger tail):")
+        for rec in last_dec[-4:]:
+            lines.append("  " + decisions.render_record(rec))
+        unclosed = [r for r in last_dec if r.get("status") != "closed"]
+        if unclosed:
+            lines.append(
+                "  ⚠ unclosed decision(s) above: the cluster was "
+                "mid-flip on "
+                + ", ".join(str(r.get("kind")) for r in unclosed)
+                + " at death — the adaptation never got its outcome "
+                "measured"
+            )
     audit_tail = pm.get("audit_tail") or []
     if audit_tail:
         lines.append("final audit events:")

@@ -12,7 +12,9 @@ serve the whole telemetry subsystem):
   (load in chrome://tracing or ui.perfetto.dev);
 - ``/audit``    the resize/strategy audit log as JSON;
 - ``/steptrace`` the step plane's recent per-step timelines (ISSUE 13)
-  with the perf-clock anchors the cluster merge aligns on.
+  with the perf-clock anchors the cluster merge aligns on;
+- ``/decisions`` the decision ledger's adaptation records (ISSUE 15)
+  with the same perf-clock anchors for the cluster merge.
 
 Shutdown is clean: ``stop()`` both shuts the serve loop down AND closes
 the listening socket, so a stopped peer never leaks its telemetry port
@@ -45,6 +47,13 @@ def _steptrace_doc() -> dict:
     return steptrace.get_store().export()
 
 
+def _decisions_doc() -> dict:
+    # lazy for the same reason: the ledger's knobs resolve at first use
+    from kungfu_tpu.telemetry import decisions
+
+    return decisions.get_ledger().export()
+
+
 class TelemetryServer:
     def __init__(
         self,
@@ -74,6 +83,10 @@ class TelemetryServer:
             ),
             "/steptrace": lambda: (
                 json.dumps(_steptrace_doc()),
+                "application/json",
+            ),
+            "/decisions": lambda: (
+                json.dumps(_decisions_doc()),
                 "application/json",
             ),
         }

@@ -32,6 +32,8 @@ def main() -> None:
         argv += ["--zero"]
     if os.environ.get("KF_BENCH_REPLAN", ""):
         argv += ["--replan"]
+    if os.environ.get("KF_BENCH_DECISIONS", ""):
+        argv += ["--decisions"]
     if os.environ.get("KF_BENCH_STEPS", ""):
         argv += ["--steps"]
     sys.argv = argv
