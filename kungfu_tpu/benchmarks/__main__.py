@@ -705,6 +705,59 @@ def report_steps(model: str) -> None:
         )
 
 
+def report_resources(model: str) -> None:
+    """The --resources report (ISSUE 16): where this worker's CPU time
+    actually went during the bench, from the resource plane's per-thread
+    accounting — the window spans the bench because main() anchors a
+    baseline sweep before dispatch. Rank 0 only; reads this worker's own
+    plane (the bench has no aggregator). The ceiling line is the same
+    Amdahl clamp derive_plan applies: a peer that burned cf of a core on
+    compute cannot speed up more than 1/cf by re-ordering the ring, so
+    a raw predicted gain above that is the r12 86x-style fiction."""
+    from kungfu_tpu import api
+    from kungfu_tpu.telemetry import resource
+
+    if api.current_rank() != 0:
+        return
+    plane = resource.get_plane()
+    if not plane.acct.supported():
+        log.echo(
+            f"RESOURCES {model}: /proc per-thread accounting unsupported "
+            "on this platform"
+        )
+        return
+    plane.maybe_sweep(force=True)
+    doc = plane.export()
+    if doc.get("sweeps", 0) < 2 or not doc.get("window_s"):
+        log.echo(
+            f"RESOURCES {model}: no accounting window (plane came up "
+            "after the bench?)"
+        )
+        return
+    buckets = doc.get("buckets") or {}
+    parts = ", ".join(
+        f"{b} {info['frac']:.0%}"
+        for b in resource.BUCKETS
+        for info in [buckets.get(b) or {}]
+        if info.get("frac")
+    )
+    log.echo(
+        f"RESOURCES {model}: cpu {doc.get('cpu_frac') or 0.0:.0%} of "
+        f"{doc['cores']} core(s) over {doc['window_s']:.1f} s, engine "
+        f"{doc.get('engine_frac') or 0.0:.0%} of busy"
+        + (f" [{parts}]" if parts else "")
+        + (" SATURATED" if doc.get("saturated") else "")
+    )
+    cf = plane.compute_frac()
+    if cf > 0.0:
+        log.echo(
+            f"RESOURCES ceiling: compute floor {cf:.2f} clamps any "
+            f"predicted re-plan gain to <= {1.0 / max(cf, 1e-6):.2f}x "
+            "(derive_plan's Amdahl clamp; a raw prediction above this "
+            "is unrealizable on this peer)"
+        )
+
+
 def bench_host(model: str, iters: int, warmup: int = 4) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
@@ -917,6 +970,14 @@ def main() -> None:
         "scheduler the plane instruments)",
     )
     p.add_argument(
+        "--resources", action="store_true", dest="resources_report",
+        help="HOST only: after the bench, print the RESOURCES report — "
+        "per-bucket CPU attribution over the bench window from the "
+        "resource plane's per-thread accounting, plus the compute-floor "
+        "gain ceiling derive_plan's clamp enforces (rides any A/B; "
+        "KF_BENCH_RESOURCES=1 in the harness mirrors it)",
+    )
+    p.add_argument(
         "--passes", type=int, default=16,
         help="HOST --async only: simulated-backprop passes per tensor "
         "(compute:comm ratio of the A/B; 16 is a conservative LOW bound "
@@ -955,11 +1016,12 @@ def main() -> None:
     if args.method != "HOST" and (
         args.algo or args.wire or args.wire_ab or args.async_ab
         or args.zero_ab or args.steps_report or args.replan_ab
+        or args.resources_report
     ):
         # the default method is XLA: silently measuring the wrong plane
         # is worse than an error
-        p.error("--algo/--wire/--wire-ab/--async/--zero/--replan/--steps "
-                "only apply to --method HOST")
+        p.error("--algo/--wire/--wire-ab/--async/--zero/--replan/--steps/"
+                "--resources only apply to --method HOST")
     if sum(1 for f in (args.wire_ab, args.async_ab, args.zero_ab,
                        args.replan_ab) if f) > 1:
         p.error("--wire-ab/--async/--zero/--replan are separate A/Bs — "
@@ -995,6 +1057,12 @@ def main() -> None:
         from kungfu_tpu.telemetry import config as tconfig
 
         tconfig.enable("metrics")
+        if args.resources_report:
+            # anchor the accounting window NOW so the report's closing
+            # sweep attributes exactly the benched iterations
+            from kungfu_tpu.telemetry import resource as _tres
+
+            _tres.get_plane().maybe_sweep(force=True)
     if args.method == "XLA":
         bench_xla(args.model, args.iters)
     elif args.method == "P2P":
@@ -1014,6 +1082,8 @@ def main() -> None:
         bench_host(args.model, args.iters)
     if args.method == "HOST" and args.steps_report:
         report_steps(args.model)
+    if args.method == "HOST" and args.resources_report:
+        report_resources(args.model)
 
 
 if __name__ == "__main__":

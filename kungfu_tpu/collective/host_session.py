@@ -628,6 +628,34 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         ))
         return out.reshape(k, k).astype(np.float64)
 
+    def measured_compute_frac(self) -> float:
+        """All-gather each peer's measured window CPU fraction (the
+        resource plane's compute floor, ISSUE 16) and return the
+        cluster MAX — identical bytes on every peer by construction,
+        like :meth:`measured_matrix`, so ``derive_plan``'s Amdahl clamp
+        stays a pure function of shared input. 0.0 when nobody has a
+        measurement (no clamp: missing data must never fabricate
+        pessimism). Collective: call in lockstep on every peer."""
+        k = self.size
+        mine = 0.0
+        try:
+            from kungfu_tpu.telemetry import resource as _tres
+
+            mine = max(0.0, min(1.0, _tres.get_plane().compute_frac()))
+        # kfcheck: disable=KF400 — an unmeasurable local floor must
+        # degrade to 0.0 (no clamp), never kill the re-plan round; every
+        # peer still runs the same all_gather below so the protocol
+        # stays lockstep
+        except Exception:  # noqa: BLE001
+            pass
+        send = np.array([np.float32(mine)], np.float32)
+        out = np.zeros(k, np.float32)
+        self.all_gather(Workspace(
+            send=send, recv=out, op=ReduceOp.SUM,
+            name=self._replan_name("cf"),
+        ))
+        return round(float(out.max()), 6)
+
     def check_replan(
         self, want: bool = True, min_gain: float = 1.05, tag: str = ""
     ) -> Optional[rp.RingPlan]:
@@ -668,8 +696,14 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             self._replan_seq += 1
             return None
         matrix = self.measured_matrix()
+        # the measured compute floor (ISSUE 16): a ring re-order only
+        # shrinks the network share of the step, so the predicted gain
+        # is clamped by the busiest peer's CPU fraction — gathered like
+        # the matrix so every peer clamps by the identical scalar
+        compute_frac = self.measured_compute_frac()
         plan = rp.derive_plan(
             matrix, mode=self.replan_mode, current=self._ring_plan,
+            compute_frac=compute_frac,
         )
         if plan is None or not self._replan_worthwhile(plan, min_gain):
             # nothing derivable, or the predicted win doesn't clear the

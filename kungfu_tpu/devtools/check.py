@@ -14,6 +14,8 @@ set ONCE (per-file cache and all) and sections the report by concern:
   kinds (KF602, ISSUE 13 satellite)
 - ``[audit-docs]``   docs/telemetry.md's audit event table vs recorded
   audit kinds (KF604, ISSUE 15 satellite)
+- ``[signal-docs]``  docs/telemetry.md's policy signal table vs the
+  keys written into PolicyContext.metrics (KF605, ISSUE 16 satellite)
 
 Exit status is the contract — 0 clean, 1 findings — matching the
 kfcheck CLI. ``tests/test_kfcheck.py`` invokes it as the tier-1 gate;
@@ -34,6 +36,7 @@ _DOC_RULES_KNOBS = ("KF102",)
 _DOC_RULES_METRICS = ("KF600", "KF601")
 _DOC_RULES_SPANS = ("KF602",)
 _DOC_RULES_AUDIT = ("KF604",)
+_DOC_RULES_SIGNALS = ("KF605",)
 
 
 def _section(findings: List["core.Finding"], title: str, rules) -> List[str]:
@@ -58,6 +61,7 @@ def main(argv=None) -> int:
     doc_rules = (
         set(_DOC_RULES_KNOBS) | set(_DOC_RULES_METRICS)
         | set(_DOC_RULES_SPANS) | set(_DOC_RULES_AUDIT)
+        | set(_DOC_RULES_SIGNALS)
     )
     code = [f for f in findings if f.rule not in doc_rules]
     out: List[str] = []
@@ -66,6 +70,7 @@ def main(argv=None) -> int:
     out.extend(_section(findings, "metric-docs", _DOC_RULES_METRICS))
     out.extend(_section(findings, "span-docs", _DOC_RULES_SPANS))
     out.extend(_section(findings, "audit-docs", _DOC_RULES_AUDIT))
+    out.extend(_section(findings, "signal-docs", _DOC_RULES_SIGNALS))
     n = len(findings)
     out.append(
         "check: clean" if n == 0

@@ -1149,6 +1149,136 @@ def check_audit_kinds_documented(project: Project) -> List[Finding]:
     return out
 
 
+# KF605 — policy-signal doc lint (ISSUE 16 satellite): the adaptation-
+# signal shape of KF602/604 in one bidirectional rule. Every namespaced
+# signal key LITERAL that reaches ``PolicyContext.metrics`` — written
+# directly (``ctx.metrics["replan/last_order"] = ...``) or returned by
+# a plane's ``signals()``/``local_signals()``/``health_signals()``
+# function that policy.py merges in — must appear in docs/telemetry.md's
+# policy signal table, and every table row must still exist in code.
+# Signals are the contract between the telemetry planes and the
+# adaptation policies; an undocumented key is a steering input nobody
+# can audit, and a stale row describes a lever that no longer exists.
+# Keys assembled at runtime (none today) would be declared in
+# _SIGNAL_INDIRECT so the scan stays honest about its blind spot.
+
+_SIGNAL_FNS = frozenset({"signals", "local_signals", "health_signals"})
+_SIGNAL_INDIRECT: frozenset = frozenset()
+_SIGNAL_KEY_RE = re.compile(r"^[a-z_]+/[a-z_]+$")
+
+_SIGNAL_TABLE_HEADING = "## Policy signal table"
+
+
+def _source_signal_keys(project: Project) -> Set[str]:
+    keys: Set[str] = set()
+
+    def _maybe(value: object) -> None:
+        if isinstance(value, str) and _SIGNAL_KEY_RE.match(value):
+            keys.add(value)
+
+    for ctx in project.files:
+        if ctx.tree is None:
+            continue
+        for node in ctx.walk():
+            # ctx.metrics["x/y"] = ... anywhere in the package
+            if isinstance(node, ast.Assign):
+                for tgt in node.targets:
+                    if (
+                        isinstance(tgt, ast.Subscript)
+                        and _last_segment(tgt.value) == "metrics"
+                        and isinstance(tgt.slice, ast.Constant)
+                    ):
+                        _maybe(tgt.slice.value)
+            # dict keys and subscript writes inside the signal builders
+            if not (isinstance(node, ast.FunctionDef)
+                    and node.name in _SIGNAL_FNS):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Dict):
+                    for k in sub.keys:
+                        if isinstance(k, ast.Constant):
+                            _maybe(k.value)
+                elif isinstance(sub, ast.Assign):
+                    for tgt in sub.targets:
+                        if (isinstance(tgt, ast.Subscript)
+                                and isinstance(tgt.slice, ast.Constant)):
+                            _maybe(tgt.slice.value)
+    return keys
+
+
+def _signal_table_rows(project: Project) -> Optional[List[Tuple[int, str]]]:
+    """(lineno, signal key) per row of docs/telemetry.md's policy signal
+    table, or None when the doc/heading is missing."""
+    got = _telemetry_doc(project)
+    if got is None:
+        return None
+    rows: List[Tuple[int, str]] = []
+    in_table = False
+    for i, line in enumerate(got[1], start=1):
+        if line.strip() == _SIGNAL_TABLE_HEADING:
+            in_table = True
+            continue
+        if in_table and line.startswith("## "):
+            break
+        if in_table and line.startswith("| `"):
+            for name in re.findall(r"`([a-z_]+/[a-z_]+)`",
+                                   line.split("|")[1]):
+                rows.append((i, name))
+    return rows if in_table else None
+
+
+@rule(
+    "KF605",
+    "signal-doc-lint",
+    "every namespaced policy-signal key reaching PolicyContext.metrics "
+    "(direct metrics[...] writes and the planes' signals()/"
+    "local_signals()/health_signals() builders) must appear in "
+    "docs/telemetry.md's policy signal table AND every table row must "
+    "still exist in code — signals are the steering contract between "
+    "telemetry and adaptation, and an undocumented key (or stale row) "
+    "hides a lever from exactly the operator tuning it (the KF602/604 "
+    "contract, for adaptation signals)",
+    scope="project",
+)
+def check_signals_documented(project: Project) -> List[Finding]:
+    keys = _source_signal_keys(project) | _SIGNAL_INDIRECT
+    out: List[Finding] = []
+    if len(keys) <= 10:
+        # the scan must keep finding the signal builders — a rename
+        # must not silently turn this rule into a no-op
+        out.append(Finding(
+            "KF605", "docs/telemetry.md", 1,
+            f"signal-key scan found only {len(keys)} keys — the AST "
+            "scan looks broken (signals() rename?), fix the rule "
+            "before trusting it",
+        ))
+        return out
+    rows = _signal_table_rows(project)
+    if rows is None:
+        return [Finding(
+            "KF605", "docs/telemetry.md", 1,
+            f"docs/telemetry.md has no `{_SIGNAL_TABLE_HEADING}` section "
+            "— add the policy signal table (one row per signal key)",
+        )]
+    documented = {name for _, name in rows}
+    for name in sorted(keys - documented):
+        out.append(Finding(
+            "KF605", "docs/telemetry.md", 1,
+            f"policy signal {name!r} is written in the package but "
+            "absent from docs/telemetry.md's policy signal table — add "
+            "a row",
+        ))
+    for lineno, name in rows:
+        if name not in keys:
+            out.append(Finding(
+                "KF605", "docs/telemetry.md", lineno,
+                f"docs/telemetry.md's policy signal table documents "
+                f"{name!r} but no code writes it — drop the stale row "
+                "(runtime-assembled keys belong in _SIGNAL_INDIRECT)",
+            ))
+    return out
+
+
 # ---------------------------------------------------------------------
 # KF7xx — distributed protocol (ISSUE 12: the first cross-module rules)
 # ---------------------------------------------------------------------

@@ -3,6 +3,7 @@
        python3 -m kungfu_tpu.info links [--watch] [--json] [--interval S] [URL]
        python3 -m kungfu_tpu.info steps [--watch] [--json] [--interval S] [-n N] [URL]
        python3 -m kungfu_tpu.info decisions [--watch] [--json] [--interval S] [-n N] [URL]
+       python3 -m kungfu_tpu.info resources [--watch] [--json] [--interval S] [URL]
        python3 -m kungfu_tpu.info postmortem [DIR|URL]
 
 Prints framework, backend and cluster-env diagnostics (parity:
@@ -42,9 +43,16 @@ outcome (realized gain, delivered/neutral/regressed verdict, regression
 watchdog flag). This is the "the cluster adapted — did it help?" view —
 see the runbook in docs/telemetry.md.
 
-`--json` (top/links/steps/decisions) emits the raw cluster endpoint
-payload instead of the rendered table — one flag for scripting/CI,
-applied in the shared fetch loop.
+`resources` renders the resource plane (ISSUE 16): every worker's
+per-thread CPU attribution from the runner's /cluster/resources
+endpoint — per peer the window CPU fraction, effective cores, the
+per-bucket busy split (train/walk/codec/sched/telemetry/other) and the
+compute-saturation flag. This is the "is this peer compute-bound or
+network-bound?" view — see the runbook in docs/telemetry.md.
+
+`--json` (top/links/steps/decisions/resources) emits the raw cluster
+endpoint payload instead of the rendered table — one flag for
+scripting/CI, applied in the shared fetch loop.
 
 `postmortem` reconstructs the death timeline of crashed workers
 (ISSUE 3): point it at a telemetry run dir (KF_TELEMETRY_DIR, default
@@ -238,24 +246,37 @@ def render_top(health: dict) -> str:
     /cluster/health, stragglers flagged in the last column. The CRIT%
     and CRIT-EDGE columns come from the step plane (ISSUE 13): the share
     of recent merged steps this peer was elected critical in, and the
-    blocking edge those elections named."""
+    blocking edge those elections named. The CPU% and TRAIN% columns
+    come from the resource plane (ISSUE 16): the window CPU fraction of
+    the peer's effective cores and the training loop's share of the
+    busy window; a flagged straggler carries its measured cause
+    (STRAGGLER(network) vs STRAGGLER(compute))."""
     steps = health.get("steps") or {}
     crit_frac = steps.get("crit_frac") or {}
     crit_edge = steps.get("crit_edge") or {}
+    res_peers = (health.get("resources") or {}).get("peers") or {}
     cols = ("PEER", "STEP/S", "P50(ms)", "P99(ms)", "TX", "RX",
-            "RTT(ms)", "AGE(s)", "CRIT%", "CRIT-EDGE", "FLAGS")
+            "RTT(ms)", "AGE(s)", "CPU%", "TRAIN%", "CRIT%", "CRIT-EDGE",
+            "FLAGS")
     rows = [cols]
     peers = health.get("peers", {})
     for label in sorted(peers):
         p = peers[label]
         flags = []
         if p.get("straggler"):
-            flags.append("STRAGGLER")
+            cause = p.get("straggler_cause")
+            flags.append(
+                f"STRAGGLER({cause})"
+                if cause and cause != "unknown" else "STRAGGLER"
+            )
         if p.get("rtt_outlier"):
             flags.append("RTT")
         if p.get("error"):
             flags.append("UNREACHABLE")
         cf = crit_frac.get(label)
+        r = res_peers.get(label) or {}
+        cpu = r.get("cpu_frac")
+        train = r.get("train_frac")
         rows.append((
             label,
             _fmt_num(p.get("step_rate"), "{:.2f}"),
@@ -265,6 +286,8 @@ def render_top(health: dict) -> str:
             _fmt_bytes(p.get("bytes_rx")),
             _fmt_num(p.get("rtt_ms"), "{:.2f}"),
             _fmt_num(p.get("last_scrape_age_s")),
+            f"{cpu:.0%}" if isinstance(cpu, (int, float)) else "-",
+            f"{train:.0%}" if isinstance(train, (int, float)) else "-",
             f"{cf:.0%}" if isinstance(cf, (int, float)) else "-",
             f"→{crit_edge[label]}" if label in crit_edge else "-",
             ",".join(flags) or "ok",
@@ -291,6 +314,9 @@ def render_top(health: dict) -> str:
                 if isinstance(ov, (int, float)) else ""
             )
         )
+    sat = (health.get("resources") or {}).get("saturated") or []
+    if sat:
+        summary += f"; compute-saturated: {', '.join(sat)}"
     return "\n".join([summary] + lines)
 
 
@@ -535,6 +561,41 @@ def _cmd_decisions(argv) -> int:
     )
 
 
+def render_resources(doc: dict) -> str:
+    """One frame of `info resources`: the merged per-peer CPU
+    attribution table — rendering shared with the worker view
+    (resource.render_resources) so the live view and tests read
+    identically."""
+    from kungfu_tpu.telemetry import resource as _tres
+
+    if not (doc.get("peers") or {}):
+        return (
+            "no resource documents yet — workers publish /resources "
+            "once telemetry is on (kfrun -w) and a scrape has landed; "
+            "per-thread accounting needs Linux (/proc)"
+        )
+    return "\n".join(_tres.render_resources(doc))
+
+
+def _cmd_resources(argv) -> int:
+    watch = "--watch" in argv
+    interval, rc = _interval_flag(argv, "resources")
+    if rc is not None:
+        return rc
+    url = _cluster_url(argv, "/cluster/resources")
+    if not url:
+        print(
+            "info resources: no /cluster/resources URL — pass one (or a "
+            "runner debug endpoint), or run under kfrun -w -debug-port N "
+            "(which exports KF_CLUSTER_HEALTH_URL)",
+            file=sys.stderr,
+        )
+        return 2
+    return _fetch_render_loop(
+        "resources", url, _json_flag(argv, render_resources), watch, interval
+    )
+
+
 def _cmd_postmortem(argv) -> int:
     from kungfu_tpu.telemetry import flight
 
@@ -586,6 +647,8 @@ def main(argv) -> None:
         sys.exit(_cmd_steps(argv[1:]))
     if argv and argv[0] == "decisions":
         sys.exit(_cmd_decisions(argv[1:]))
+    if argv and argv[0] == "resources":
+        sys.exit(_cmd_resources(argv[1:]))
     if argv and argv[0] == "postmortem":
         sys.exit(_cmd_postmortem(argv[1:]))
     _show_versions()

@@ -285,6 +285,24 @@ _knob("KF_DECISION_PATIENCE", "2", _int,
       "before `adaptation_regressed` fires.",
       section=_SEC_DECISION, kind="int")
 
+_SEC_RESOURCE = "Resource attribution"
+_knob("KF_RESOURCE_INTERVAL", "2.0", _float,
+      "Minimum seconds between per-thread CPU accounting sweeps "
+      "(/proc/self/task deltas). Sweeps are on-demand — triggered by "
+      "/resources scrapes, policy signal refreshes and flight "
+      "snapshots — so this throttles, it does not schedule.",
+      section=_SEC_RESOURCE, kind="float")
+_knob("KF_RESOURCE_SAMPLE_HZ", "0", _float,
+      "Sampling-profiler rate (stack samples per second) splitting the "
+      "main thread into train-compute vs blocked-in-engine with "
+      "module-prefix aggregation. 0 (the default) means the sampler "
+      "thread is never started and allocates nothing.",
+      section=_SEC_RESOURCE, kind="float")
+_knob("KF_RESOURCE_KEEP", "512", _int,
+      "Sampling-profiler ring size: how many recent stack samples the "
+      "module-prefix aggregation is computed over.",
+      section=_SEC_RESOURCE, kind="int")
+
 _SEC_FLIGHT = "Flight recorder"
 _knob("KF_FLIGHT", "", _bool,
       "Explicit on/off override for the flight recorder; unset means "

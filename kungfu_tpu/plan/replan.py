@@ -292,6 +292,7 @@ def derive_plan(
     bw: np.ndarray,
     mode: str = "auto",
     current: Optional[RingPlan] = None,
+    compute_frac: float = 0.0,
 ) -> Optional[RingPlan]:
     """Turn the merged k×k bandwidth matrix into a :class:`RingPlan`,
     or None when re-planning would be a no-op (no estimates, uniform
@@ -300,7 +301,18 @@ def derive_plan(
     ``mode`` mirrors ``KF_CONFIG_REPLAN``: ``ring`` reorders only,
     ``ring+segments``/``auto`` also weight the segments by measured
     per-peer throughput. Pure function of (matrix bytes, mode, current
-    plan) — the cross-peer determinism the adoption digest asserts."""
+    plan, compute_frac) — the cross-peer determinism the adoption
+    digest asserts; callers must feed a cluster-agreed ``compute_frac``
+    (``HostSession.check_replan`` all-gathers it).
+
+    ``compute_frac`` is the measured compute floor from the resource
+    plane (ISSUE 16): the fraction of the step the busiest peer spends
+    burning CPU rather than waiting on the network. Amdahl caps what a
+    ring re-order can buy — only the network share shrinks — so the
+    predicted gain is clamped to ``1 / compute_frac`` (r12's ledger
+    showed the unclamped min-edge-bandwidth predictor 86x optimistic on
+    a CPU-bound host run). 0.0 = unmeasured, no clamp: a missing
+    measurement must never fabricate pessimism."""
     if mode in ("off", ""):
         return None
     if mode not in ("ring", "ring+segments", "auto"):
@@ -324,4 +336,7 @@ def derive_plan(
     gain = 1.0
     if old_min and new_min and old_min > 0:
         gain = new_min / old_min
+    cf = float(compute_frac)
+    if cf > 0.0 and np.isfinite(cf):
+        gain = min(gain, 1.0 / max(min(cf, 1.0), 1e-6))
     return RingPlan(order=order, weights=weights, gain=round(gain, 6))

@@ -38,6 +38,13 @@ and ``decision/last_kind`` + ``decision/last_realized_gain`` +
 ``decision/regressed`` surface the latest measured outcome — the trust
 signals an unattended autoscaler (ROADMAP item 4) needs before it can
 act without an operator.
+
+The resource plane (ISSUE 16) adds ``resource/cpu_frac`` +
+``resource/engine_frac`` + ``resource/saturated`` (worker-local
+per-thread CPU attribution, overridden by the cluster merge) and
+``resource/saturated_peers`` (cluster-wide only) — the measured
+compute-side inputs that tell a policy whether a slow peer is
+network-bound (re-plan around it) or compute-bound (shed it).
 """
 
 from __future__ import annotations
@@ -139,6 +146,7 @@ class PolicyRunner:
             from kungfu_tpu.collective.host_session import get_walk_profiler
             from kungfu_tpu.telemetry import decisions as _tdec
             from kungfu_tpu.telemetry import link as _link
+            from kungfu_tpu.telemetry import resource as _tres
             from kungfu_tpu.telemetry import steptrace as _steptrace
 
             for key in ("links/min_bw", "links/slowest_edge",
@@ -146,7 +154,9 @@ class PolicyRunner:
                         "step/overlap_frac", "step/queue_delay_frac",
                         "step/critical_peer", "step/critical_edge",
                         "decision/last_kind", "decision/last_realized_gain",
-                        "decision/regressed"):
+                        "decision/regressed",
+                        "resource/cpu_frac", "resource/engine_frac",
+                        "resource/saturated", "resource/saturated_peers"):
                 self.ctx.metrics.pop(key, None)
             if _link.enabled():
                 self.ctx.metrics.update(_link.get_table().signals())
@@ -159,6 +169,10 @@ class PolicyRunner:
             # decision ledger (ISSUE 15): the latest measured adaptation
             # outcome, worker-local (decisions fire on every peer)
             self.ctx.metrics.update(_tdec.get_ledger().signals())
+            # resource plane (ISSUE 16): this worker's own CPU
+            # attribution — the cluster merge overrides the shared
+            # resource/* keys below when a runner aggregator is live
+            self.ctx.metrics.update(_tres.get_plane().signals())
         except Exception as e:  # noqa: BLE001 - telemetry must never kill training
             log.debug("policy: walk/link signal refresh failed: %s", e)
         try:

@@ -58,6 +58,7 @@ class DebugServer:
     - ``/cluster/links``   k×k link matrix (per-edge bandwidth/latency)
     - ``/cluster/steps``   merged per-step critical-path records
     - ``/cluster/decisions`` merged adaptation-decision ledger
+    - ``/cluster/resources`` merged per-thread CPU attribution view
     - anything else        the Stage/worker debug dump (old contract)
     """
 
@@ -90,6 +91,11 @@ class DebugServer:
             if path == "/cluster/decisions":
                 return (
                     json.dumps(agg.cluster_decisions(), indent=2),
+                    "application/json",
+                )
+            if path == "/cluster/resources":
+                return (
+                    json.dumps(agg.cluster_resources(), indent=2),
                     "application/json",
                 )
             if path == "/cluster/audit":

@@ -32,7 +32,12 @@ serves it from the watcher's debug endpoint:
   timeline — each adaptation (strategy/wire vote, re-plan, mode flip,
   resize) with its trigger, predicted gain and MEASURED outcome
   (realized gain, verdict, regression flag) — "the cluster adapted;
-  did it help?" as data.
+  did it help?" as data;
+- ``/cluster/resources`` — the resource plane (ISSUE 16): every
+  worker's ``/resources`` per-thread CPU attribution merged into one
+  view with the saturated (compute-bound) peers elected — the input
+  that lets straggler events carry ``cause=compute`` vs ``network``
+  and lets re-planning clamp predicted gains by the compute floor.
 
 On top of the snapshot the aggregator runs straggler detection
 (:mod:`~kungfu_tpu.telemetry.straggler`): rolling per-peer step-time
@@ -60,6 +65,7 @@ from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import audit, log, metrics, promparse
 from kungfu_tpu.telemetry import decisions as tdecisions
 from kungfu_tpu.telemetry import link as tlink
+from kungfu_tpu.telemetry import resource as tresource
 from kungfu_tpu.telemetry import steptrace as tstep
 from kungfu_tpu.telemetry import straggler as tstraggler
 from kungfu_tpu.telemetry.straggler import StragglerScorer
@@ -240,6 +246,11 @@ class TelemetryAggregator:
         )
         self._flagged: set = set()
         self._rtt_flagged: set = set()
+        # the measured cause behind each currently-flagged straggler
+        # (network/compute/unknown), classified once at the flag
+        # transition — /cluster/health serves it so `info top` renders
+        # the same cause the audit event recorded
+        self._causes: Dict[str, str] = {}
         self._scraped_at: Optional[float] = None  # wall time of last sweep
         # crash forensics (ISSUE 3): postmortems harvested by the
         # watcher, served at /cluster/postmortem. Deliberately NOT keyed
@@ -311,6 +322,14 @@ class TelemetryAggregator:
         _dkeep = int(knobs.get("KF_DECISION_KEEP"))
         self._decisions_keep = _dkeep if _dkeep > 0 else 64
         self._decisions_refresh_lock = threading.Lock()
+        # resource plane (ISSUE 16): the latest merged cluster view of
+        # every worker's /resources document — a CURRENT-STATE view
+        # (like health), so each refresh REPLACES it wholesale: a dead
+        # peer's frozen saturation flag steering straggler causes or
+        # the replan clamp hours later would be worse than no data
+        self._resources: dict = {}
+        self._resources_at: Optional[float] = None  # monotonic
+        self._resources_refresh_lock = threading.Lock()
         self._g_step_overlap = reg.gauge(
             "kungfu_step_overlap_ratio",
             "Latest merged step's overlap fraction: scheduler-busy comm "
@@ -567,6 +586,10 @@ class TelemetryAggregator:
             self._refresh_decisions()
         except Exception as e:  # noqa: BLE001 - the sweep must outlive a bad merge
             log.warn("cluster: decision-plane refresh failed: %s", e)
+        try:
+            self._refresh_resources()
+        except Exception as e:  # noqa: BLE001 - the sweep must outlive a bad merge
+            log.warn("cluster: resource-plane refresh failed: %s", e)
         self._publish()
         return self.cluster_health()
 
@@ -602,10 +625,12 @@ class TelemetryAggregator:
         newly_flagged = sorted(flagged - self._flagged)
         links_doc = None
         steps: List[dict] = []
+        resources: Optional[dict] = None
         if newly_flagged:
-            # measured attribution for the event (ISSUE 13 satellite):
-            # the step plane's elected edge when this peer was recently
-            # critical, else the slowest link touching it — both inputs
+            # measured attribution for the event (ISSUE 13 satellite +
+            # ISSUE 16 cause): the step plane's elected edge when this
+            # peer was recently critical, else the slowest link touching
+            # it, and the resource plane's saturation view — all inputs
             # computed once per transition batch, never per peer
             links_doc = tlink.merge_matrix(
                 {st.label: st.links for st in self.peers()},
@@ -613,13 +638,18 @@ class TelemetryAggregator:
             )
             with self._lock:
                 steps = list(self._steps)
+                resources = self._resources or None
         for peer in newly_flagged:
             sc = scores[peer]
-            edge = tstraggler.blocking_edge(peer, steps, links_doc)
+            cause, edge = tstraggler.classify_cause(
+                peer, steps, links_doc, resources
+            )
+            self._causes[peer] = cause
             log.warn(
                 "cluster: straggler detected: %s step_time=%.1fms "
-                "(cluster median %.1fms, z=%.1f, blocking edge %s)",
+                "(cluster median %.1fms, z=%.1f, cause=%s, blocking edge %s)",
                 peer, sc.value * 1e3, (cluster_median or 0) * 1e3, sc.score,
+                cause,
                 "->".join(str(e) for e in edge) if edge else "unknown",
             )
             audit.record_event(
@@ -630,8 +660,10 @@ class TelemetryAggregator:
                 step_time_ms=round(sc.value * 1e3, 3),
                 cluster_median_ms=round((cluster_median or 0) * 1e3, 3),
                 blocking_edge=edge,
+                cause=cause,
             )
         for peer in sorted(self._flagged - flagged):
+            self._causes.pop(peer, None)
             audit.record_event(
                 "straggler_cleared", peer=peer, trigger="cluster_scrape"
             )
@@ -1021,6 +1053,83 @@ class TelemetryAggregator:
             "decisions": recs,
         }
 
+    # -- resource plane (ISSUE 16) --------------------------------------
+
+    def _refresh_resources(self) -> None:
+        """Pull every worker's /resources document, align the perf
+        anchors with the clock offsets already estimated for
+        /cluster/trace and REPLACE the merged view (current state, not a
+        log: a vanished peer's stale saturation flag must not keep
+        classifying straggler causes). Whole refreshes serialize like
+        the step plane's."""
+        with self._resources_refresh_lock:
+            self._refresh_resources_locked()
+
+    def _refresh_resources_locked(self) -> None:
+        docs: Dict[str, dict] = {}
+        offsets: Dict[str, float] = {}
+        for st, body in self._fetch_all("/resources"):
+            try:
+                docs[st.label] = json.loads(body.decode())
+            except ValueError as e:
+                st.last_error = str(e)
+                continue
+            offsets[st.label] = st.clock_offset_us or 0.0
+        self._resources_at = time.monotonic()
+        merged = tresource.merge_resources(docs, offsets)
+        with self._lock:
+            self._resources = merged
+
+    def cluster_resources(self) -> dict:
+        """The /cluster/resources view: every live worker's resource
+        attribution document merged NTP-aligned, plus the cluster
+        election (saturated peers, max CPU fraction). Refreshes inline
+        when the cached merge is older than a scrape interval, so
+        one-shot consumers (`info resources` without a runner loop)
+        still see fresh attribution."""
+        now = time.monotonic()
+        if (
+            self._resources_at is None
+            or now - self._resources_at >= self.interval
+        ):
+            try:
+                self._refresh_resources()
+            except Exception as e:  # noqa: BLE001 - serve the cache over a 500
+                log.warn("cluster: inline resource refresh failed: %s", e)
+        with self._lock:
+            merged = dict(self._resources)
+        doc = {
+            "wall_time": time.time(),
+            "count": len(merged.get("peers") or {}),
+        }
+        doc.update(merged)
+        return doc
+
+    def _resources_summary(self) -> Optional[dict]:
+        """Compact resource signal for /cluster/health (the full
+        documents stay on /cluster/resources): per peer the window CPU
+        fraction, the training bucket's share of the busy window, the
+        engine share and the saturation flag — exactly the columns
+        `info top` renders."""
+        with self._lock:
+            merged = self._resources
+            if not merged or not merged.get("peers"):
+                return None
+            peers = {}
+            for label, doc in merged["peers"].items():
+                buckets = doc.get("buckets") or {}
+                peers[label] = {
+                    "cpu_frac": doc.get("cpu_frac"),
+                    "train_frac": (buckets.get("train") or {}).get("frac"),
+                    "engine_frac": doc.get("engine_frac"),
+                    "saturated": bool(doc.get("saturated")),
+                }
+            return {
+                "peers": peers,
+                "saturated": list(merged.get("saturated") or []),
+                "max_cpu_frac": merged.get("max_cpu_frac"),
+            }
+
     def _steps_summary(self) -> Optional[dict]:
         """Compact step signal for /cluster/health (the full records
         stay on /cluster/steps): the latest step's election plus each
@@ -1130,6 +1239,9 @@ class TelemetryAggregator:
                     round(sc.score, 2) if sc is not None else None
                 ),
                 "rtt_outlier": bool(rsc.flagged) if rsc is not None else False,
+                # the measured cause classified at the flag transition
+                # (network/compute/unknown); None while unflagged
+                "straggler_cause": self._causes.get(st.label),
             }
         med = self.scorer.cluster_median()
         return {
@@ -1147,6 +1259,7 @@ class TelemetryAggregator:
             "step_skew": self.scorer.skew(),
             "links": self._links_summary(),
             "steps": self._steps_summary(),
+            "resources": self._resources_summary(),
         }
 
 
@@ -1279,4 +1392,18 @@ def health_signals(
             signals["step/overlap_frac"] = steps["overlap_frac"]
         if steps.get("queue_delay_frac") is not None:
             signals["step/queue_delay_frac"] = steps["queue_delay_frac"]
+    # resource plane (ISSUE 16): the cluster view of MY OWN attribution
+    # overrides the worker-local fallback on the shared resource/* keys
+    # (same precedence as the step plane) — policies on any peer also
+    # see the cluster-wide compute-bound election
+    res = snap.get("resources") or {}
+    mine = (res.get("peers") or {}).get(me) if me else None
+    if mine:
+        if mine.get("cpu_frac") is not None:
+            signals["resource/cpu_frac"] = mine["cpu_frac"]
+        if mine.get("engine_frac") is not None:
+            signals["resource/engine_frac"] = mine["engine_frac"]
+        signals["resource/saturated"] = bool(mine.get("saturated"))
+    if res.get("saturated") is not None:
+        signals["resource/saturated_peers"] = list(res["saturated"])
     return signals

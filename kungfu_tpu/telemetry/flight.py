@@ -389,9 +389,25 @@ class FlightRecorder:
             # name the adaptation the cluster was mid-flip on at death
             # (an unclosed decision with no outcome IS that answer)
             "decisions": decisions.get_ledger().tail(DECISION_TAIL),
+            # the resource plane's attribution (ISSUE 16): a worker that
+            # died pegged at 100% telemetry CPU is a named finding, not
+            # a mystery — the final CPU split rides every snapshot
+            "resources": self._resources_doc(),
         }
         rec.update(extra)
         return rec
+
+    @staticmethod
+    def _resources_doc() -> Optional[dict]:
+        try:
+            from kungfu_tpu.telemetry import resource
+
+            return resource.get_plane().export()
+        # kfcheck: disable=KF400 — snapshot enrichment is best-effort:
+        # a failed /proc sweep must cost the record one None field, not
+        # the journal the whole snapshot
+        except Exception:  # noqa: BLE001
+            return None
 
     @staticmethod
     def _current_step() -> Optional[float]:
@@ -630,6 +646,7 @@ def harvest_postmortem(
             (last.get("steps") or [None])[-1] if last else None
         ),
         "last_decisions": (last.get("decisions") or []) if last else [],
+        "last_resources": last.get("resources") if last else None,
         "open_spans": (last.get("open_spans") or {}) if last else {},
         "audit_tail": (last.get("audit") or [])[-10:] if last else [],
         "log_tail": (last.get("log_tail") or [])[-20:] if last else [],
@@ -753,6 +770,12 @@ def render_postmortem(pm: dict) -> str:
         lines.extend(
             " " + l for l in steptrace.render_timeline(tl, peer=str(peer))
         )
+    res = pm.get("last_resources")
+    if res:
+        from kungfu_tpu.telemetry import resource as _tres
+
+        lines.append("final CPU attribution (resource plane):")
+        lines.extend(" " + l for l in _tres.render_worker_resources(res))
     last_dec = pm.get("last_decisions") or []
     if last_dec:
         lines.append("final adaptation decisions (ledger tail):")

@@ -14,7 +14,9 @@ serve the whole telemetry subsystem):
 - ``/steptrace`` the step plane's recent per-step timelines (ISSUE 13)
   with the perf-clock anchors the cluster merge aligns on;
 - ``/decisions`` the decision ledger's adaptation records (ISSUE 15)
-  with the same perf-clock anchors for the cluster merge.
+  with the same perf-clock anchors for the cluster merge;
+- ``/resources`` the resource attribution plane's per-bucket CPU
+  accounting + optional profiler aggregation (ISSUE 16), same anchors.
 
 Shutdown is clean: ``stop()`` both shuts the serve loop down AND closes
 the listening socket, so a stopped peer never leaks its telemetry port
@@ -54,6 +56,13 @@ def _decisions_doc() -> dict:
     return decisions.get_ledger().export()
 
 
+def _resources_doc() -> dict:
+    # lazy for the same reason: the plane's knobs resolve at first use
+    from kungfu_tpu.telemetry import resource
+
+    return resource.get_plane().export()
+
+
 class TelemetryServer:
     def __init__(
         self,
@@ -87,6 +96,10 @@ class TelemetryServer:
             ),
             "/decisions": lambda: (
                 json.dumps(_decisions_doc()),
+                "application/json",
+            ),
+            "/resources": lambda: (
+                json.dumps(_resources_doc()),
                 "application/json",
             ),
         }

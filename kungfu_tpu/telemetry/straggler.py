@@ -27,7 +27,7 @@ from __future__ import annotations
 import threading
 from collections import deque
 from statistics import median as _median
-from typing import Dict, Iterable, List, NamedTuple, Optional
+from typing import Dict, Iterable, List, NamedTuple, Optional, Tuple
 
 MAD_SIGMA = 1.4826  # MAD -> sigma for a normal distribution
 
@@ -164,3 +164,40 @@ def blocking_edge(
                 worst_bw = float(bw)
                 worst = [str(src), str(dst)]
     return worst
+
+
+def classify_cause(
+    peer: str,
+    steps: Optional[List[dict]] = None,
+    links: Optional[dict] = None,
+    resources: Optional[dict] = None,
+) -> Tuple[str, Optional[List[Optional[str]]]]:
+    """Name WHY a flagged peer is slow (ISSUE 16): ``(cause, edge)``
+    with cause in {network, compute, unknown}. Every cause is backed by
+    a measurement, never inferred from absence:
+
+    - the step plane elected this peer's edge as a recent critical
+      path → **network** (the direct per-step measurement, strongest);
+    - the resource plane says the peer burned >= its saturation
+      fraction of its effective cores → **compute** (a ring re-order
+      or more bandwidth cannot speed up a pegged CPU);
+    - otherwise, the slowest measured link touching the peer →
+      **network** (weaker — a matrix estimate, not a step election —
+      so the live saturation measurement outranks it);
+    - no measurement at all → **unknown** with no fabricated edge.
+
+    ``resources`` is the merged /cluster/resources document (its
+    ``peers[peer]["saturated"]`` flag)."""
+    for s in reversed(steps or []):
+        c = s.get("critical")
+        if c and str(c.get("peer")) == str(peer) and c.get("edge"):
+            return "network", [str(peer), str(c["edge"])]
+    # lazy import: straggler is imported by the scorer-only paths too
+    from kungfu_tpu.telemetry import resource as tresource
+
+    if tresource.peer_saturated(resources, peer):
+        return "compute", None
+    edge = blocking_edge(peer, steps=None, links=links)
+    if edge is not None:
+        return "network", edge
+    return "unknown", None

@@ -16,6 +16,7 @@ pin threads forever.
 
 from __future__ import annotations
 
+import itertools
 import threading
 import time
 from collections import defaultdict, deque
@@ -40,6 +41,9 @@ class CachedThreadPool:
         self._idle: Deque[_Worker] = deque()
         self._lock = threading.Lock()
         self._ttl = idle_ttl
+        # KF303-style names: the resource plane attributes these
+        # threads' CPU to the walk engine by the kf-pool- prefix
+        self._names = itertools.count()
 
     def submit(self, fn: Callable[[], None]) -> None:
         """Run fn on a cached (or new) daemon thread; never blocks."""
@@ -54,7 +58,10 @@ class CachedThreadPool:
                 return
         w = _Worker()
         w.task = fn
-        threading.Thread(target=self._loop, args=(w,), daemon=True).start()
+        threading.Thread(
+            target=self._loop, args=(w,),
+            name=f"kf-pool-{next(self._names)}", daemon=True,
+        ).start()
 
     def _loop(self, w: _Worker) -> None:
         while True:

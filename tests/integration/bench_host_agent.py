@@ -36,6 +36,8 @@ def main() -> None:
         argv += ["--decisions"]
     if os.environ.get("KF_BENCH_STEPS", ""):
         argv += ["--steps"]
+    if os.environ.get("KF_BENCH_RESOURCES", ""):
+        argv += ["--resources"]
     sys.argv = argv
     from kungfu_tpu.benchmarks.__main__ import main as bench_main
 

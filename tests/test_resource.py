@@ -1,0 +1,796 @@
+"""Resource attribution plane (ISSUE 16): /proc stat parsing and fake-
+/proc delta accounting, bucket mapping, the deterministic sampling
+profiler (plus the subprocess-asserted HZ=0 no-allocation guard), the
+pure merge math, straggler cause classification in both directions, the
+predictor's compute-floor clamp property, the aggregator integration
+(live endpoints, health summary, cause caching), info/postmortem
+rendering, the non-Linux graceful path, and the KF605 signal-doc lint
+fixtures."""
+
+import json
+import os
+import subprocess
+import sys
+import textwrap
+
+import numpy as np
+import pytest
+
+from kungfu_tpu.telemetry import metrics
+from kungfu_tpu.telemetry import resource
+from kungfu_tpu.telemetry.straggler import classify_cause
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+# ---------------------------------------------------------------------------
+# /proc stat parsing + fake-/proc delta accounting
+# ---------------------------------------------------------------------------
+
+def _stat_line(tid, comm, utime, stime):
+    """A /proc/<pid>/task/<tid>/stat line: comm may hold spaces/parens,
+    utime/stime are fields 14/15 (12/13 after the comm's closing ')')."""
+    return (
+        f"{tid} ({comm}) S 1 1 1 0 -1 4194304 100 0 0 0 "
+        f"{utime} {stime} 0 0 20 0 1 0 100"
+    )
+
+
+def test_parse_stat_basic_and_hostile_comm():
+    assert resource.parse_stat(_stat_line(7, "python", 100, 50), 100.0) \
+        == pytest.approx(1.5)
+    # comm with spaces and a ')' inside: split after the LAST ')'
+    assert resource.parse_stat(
+        _stat_line(7, "a (weird) name", 200, 0), 100.0
+    ) == pytest.approx(2.0)
+    assert resource.parse_stat("no paren here", 100.0) is None
+    assert resource.parse_stat("1 (x) S 1 2", 100.0) is None  # too short
+    assert resource.parse_stat(
+        _stat_line(7, "x", "nan-ticks", 50), 100.0
+    ) is None
+
+
+def test_bucket_mapping():
+    assert resource.bucket_for("anything", is_main=True) == "train"
+    assert resource.bucket_for("kf-sched-walk-3") == "walk_compute"
+    assert resource.bucket_for("kf-pool-17") == "walk_compute"
+    assert resource.bucket_for("kf-sched-unpack-0") == "codec"
+    assert resource.bucket_for("kf-sched-launch") == "sched"
+    assert resource.bucket_for("kf-sched-gather-1") == "sched"
+    assert resource.bucket_for("kf-cluster-scrape") == "telemetry"
+    assert resource.bucket_for("kf-resource-sample") == "telemetry"
+    # unknown names are attributed, never dropped
+    assert resource.bucket_for("ThreadPoolExecutor-0_0") == "other"
+    assert resource.bucket_for("") == "other"
+
+
+class FakeProc:
+    """A fake /proc/self/task tree the accountant's delta math runs on."""
+
+    def __init__(self, tmp_path):
+        self.dir = tmp_path / "task"
+        self.dir.mkdir()
+
+    def set(self, tid, comm, utime, stime):
+        d = self.dir / str(tid)
+        d.mkdir(exist_ok=True)
+        (d / "stat").write_text(_stat_line(tid, comm, utime, stime))
+
+    def gone(self, tid):
+        import shutil
+
+        shutil.rmtree(self.dir / str(tid))
+
+
+def _accountant(proc, names, main_tid=1):
+    return resource.CpuAccountant(
+        taskdir=str(proc.dir), clk_tck=100.0,
+        names_fn=lambda: dict(names), main_tid_fn=lambda: main_tid,
+    )
+
+
+def test_fake_proc_delta_accounting(tmp_path):
+    proc = FakeProc(tmp_path)
+    proc.set(1, "python", 100, 0)          # main -> train
+    proc.set(2, "walker", 50, 10)          # kf-sched-walk -> walk_compute
+    proc.set(3, "scraper", 20, 0)          # kf-cluster -> telemetry
+    acct = _accountant(
+        proc, {1: "MainThread", 2: "kf-sched-walk-0", 3: "kf-cluster-x"}
+    )
+    assert acct.supported()
+    acct.sweep()
+    snap = acct.snapshot()
+    # first sweep: full history lands in TOTALS, never in the window
+    assert snap["totals"]["train"] == pytest.approx(1.0)
+    assert snap["totals"]["walk_compute"] == pytest.approx(0.6)
+    assert snap["totals"]["telemetry"] == pytest.approx(0.2)
+    assert sum(snap["window"].values()) == 0.0
+    assert snap["sweeps"] == 1 and snap["threads"] == 3
+
+    proc.set(1, "python", 130, 0)          # +0.3s train
+    proc.set(2, "walker", 90, 30)          # +0.6s walk_compute
+    proc.set(3, "scraper", 20, 0)          # idle
+    proc.set(4, "mystery", 500, 0)         # new unnamed thread -> other
+    acct.sweep()
+    snap = acct.snapshot()
+    assert snap["window"]["train"] == pytest.approx(0.3)
+    assert snap["window"]["walk_compute"] == pytest.approx(0.6)
+    assert snap["window"]["telemetry"] == 0.0
+    # first-seen mid-run: totals yes, window no (like-for-like intervals)
+    assert snap["window"]["other"] == 0.0
+    assert snap["totals"]["other"] == pytest.approx(5.0)
+    assert snap["totals"]["train"] == pytest.approx(1.3)
+    assert snap["window_s"] > 0
+    assert snap["sweeps"] == 2 and snap["threads"] == 4
+
+    # a vanished thread stops contributing; no negative deltas ever
+    proc.gone(2)
+    proc.set(1, "python", 130, 0)
+    acct.sweep()
+    snap = acct.snapshot()
+    assert sum(snap["window"].values()) == 0.0
+    assert snap["threads"] == 3
+
+
+def test_plane_fractions_and_signals_on_fake_proc(tmp_path):
+    proc = FakeProc(tmp_path)
+    proc.set(1, "python", 0, 0)
+    proc.set(2, "walker", 0, 0)
+    acct = _accountant(proc, {1: "MainThread", 2: "kf-sched-walk-0"})
+    plane = resource.ResourcePlane(
+        interval=0.0, sample_hz=0.0, accountant=acct, cores_fn=lambda: 2.0
+    )
+    assert plane.signals() == {}  # one sweep: no window, no fabrication
+    # burn: 1.0s train + 0.8s walk over whatever wall elapsed
+    proc.set(1, "python", 100, 0)
+    proc.set(2, "walker", 80, 0)
+    sig = plane.signals()
+    assert set(sig) == {
+        "resource/cpu_frac", "resource/engine_frac", "resource/saturated"
+    }
+    assert sig["resource/cpu_frac"] > 0
+    # engine share is walk / (train + walk) regardless of wall time
+    assert sig["resource/engine_frac"] == pytest.approx(0.8 / 1.8, rel=1e-3)
+    # compute_frac re-sweeps (interval=0.0): feed it its own fresh window
+    proc.set(1, "python", 200, 0)
+    assert plane.compute_frac() > 0
+    # export sweeps too: give it 1.0s train + 1.0s walk to attribute
+    proc.set(1, "python", 300, 0)
+    proc.set(2, "walker", 180, 0)
+    doc = plane.export(peer="pX")
+    assert doc["peer"] == "pX" and doc["supported"] is True
+    assert doc["cores"] == 2.0
+    assert doc["buckets"]["train"]["frac"] == pytest.approx(0.5, rel=1e-3)
+    assert doc["buckets"]["walk_compute"]["frac"] == pytest.approx(
+        0.5, rel=1e-3
+    )
+    assert "profile" not in doc  # hz=0: no profiler section at all
+    plane.close()
+
+
+def test_cores_fallback_on_error():
+    def boom():
+        raise OSError("no affinity surface")
+
+    plane = resource.ResourcePlane(
+        interval=60.0, sample_hz=0.0,
+        accountant=resource.CpuAccountant(taskdir="/nonexistent-task"),
+        cores_fn=boom,
+    )
+    assert plane.cores() == 1.0
+    plane.close()
+
+
+def test_non_linux_graceful(tmp_path):
+    acct = resource.CpuAccountant(taskdir=str(tmp_path / "nope"))
+    assert not acct.supported()
+    acct.sweep()  # no-op, no exception
+    assert acct.snapshot()["sweeps"] == 0
+    plane = resource.ResourcePlane(
+        interval=0.0, sample_hz=0.0, accountant=acct, cores_fn=lambda: 4.0
+    )
+    assert plane.signals() == {}
+    assert plane.compute_frac() == 0.0
+    doc = plane.export(peer="pY")
+    assert doc["supported"] is False
+    assert resource.render_worker_resources(doc) == [
+        "resource accounting unsupported on this platform"
+    ]
+    plane.close()
+
+
+# ---------------------------------------------------------------------------
+# sampling profiler: deterministic with injected frames; HZ=0 allocates
+# nothing (subprocess)
+# ---------------------------------------------------------------------------
+
+def _frame(modname):
+    """A real frame object whose module is `modname` (eval's frame gets
+    the supplied globals; its f_back chain is the test stack, which is
+    never inside kungfu_tpu)."""
+    return eval("sys._getframe()", {"__name__": modname, "sys": sys})
+
+
+def test_classify_main_frame():
+    assert resource.classify_main_frame(
+        _frame("kungfu_tpu.collective.host_session")
+    ) == "engine"
+    assert resource.classify_main_frame(_frame("numpy.core")) \
+        == "train_compute"
+
+
+def test_sampler_deterministic_with_injected_frames():
+    prof = resource.SamplingProfiler(hz=1000.0, keep=8, main_tid_fn=lambda: 1)
+    frames = {
+        1: _frame("kungfu_tpu.collective.host_session"),
+        2: _frame("numpy.core.multiarray"),
+    }
+    prof.sample_once(frames=frames)
+    prof.sample_once(frames=frames)
+    p = prof.profile()
+    assert p["samples"] == 2
+    assert p["main"] == {"train_compute": 0, "engine": 2}
+    assert p["main_engine_frac"] == 1.0
+    # module prefixes aggregate at 2 segments
+    assert p["modules"]["kungfu_tpu.collective"] == 2
+    assert p["modules"]["numpy.core"] == 2
+
+    # main thread in user code classifies the other way
+    prof2 = resource.SamplingProfiler(hz=1000.0, keep=8, main_tid_fn=lambda: 1)
+    prof2.sample_once(frames={1: _frame("my_train_script")})
+    assert prof2.profile()["main_engine_frac"] == 0.0
+
+
+def test_sampler_ring_bounded():
+    prof = resource.SamplingProfiler(hz=1000.0, keep=2, main_tid_fn=lambda: 1)
+    for mod in ("a", "b", "c"):
+        prof.sample_once(frames={1: _frame(mod)})
+    p = prof.profile()
+    assert p["samples"] == 2
+    assert set(p["modules"]) == {"b", "c"}
+
+
+def test_hz_zero_profiler_allocates_nothing_subprocess():
+    """The acceptance's overhead guard: KF_RESOURCE_SAMPLE_HZ=0 must
+    construct NO profiler object, start no sampler thread and attach no
+    profile section — asserted in a subprocess so the env is read fresh
+    and no other test's profilers pollute the allocation counter."""
+    code = textwrap.dedent("""
+        import threading
+        from kungfu_tpu.telemetry import resource
+        plane = resource.get_plane()
+        assert plane.profiler is None, plane.profiler
+        plane.maybe_sweep(force=True)
+        doc = plane.export()
+        assert "profile" not in doc, sorted(doc)
+        assert resource.SamplingProfiler.allocations == 0, \\
+            resource.SamplingProfiler.allocations
+        names = [t.name for t in threading.enumerate()]
+        assert "kf-resource-sample" not in names, names
+        print("RESOURCE_GUARD_OK")
+    """)
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO + os.pathsep + env.get("PYTHONPATH", "")
+    env["KF_RESOURCE_SAMPLE_HZ"] = "0"
+    env["JAX_PLATFORMS"] = "cpu"
+    r = subprocess.run(
+        [sys.executable, "-c", code], capture_output=True, text=True,
+        env=env, cwd=REPO, timeout=120,
+    )
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "RESOURCE_GUARD_OK" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# merge math + straggler cause classification (pure)
+# ---------------------------------------------------------------------------
+
+def _doc(peer, cpu_frac, saturated, perf=1000.0):
+    return {
+        "peer": peer, "perf_now_us": perf, "supported": True,
+        "cores": 2.0, "cpu_frac": cpu_frac, "engine_frac": 0.5,
+        "saturated": saturated,
+        "buckets": {
+            b: {"cpu_s": 1.0, "window_s": 0.1, "frac": 0.2}
+            for b in resource.BUCKETS
+        },
+    }
+
+
+def test_merge_resources_election_and_alignment():
+    merged = resource.merge_resources(
+        {
+            "pA": _doc("pA", 0.95, True, perf=1000.0),
+            "pB": _doc("pB", 0.30, False, perf=1000.0),
+            "pC": {},  # failed scrape: skipped, not fabricated
+        },
+        {"pA": 500.0, "pB": -250.0},
+    )
+    assert sorted(merged["peers"]) == ["pA", "pB"]
+    assert merged["peers"]["pA"]["perf_now_us"] == pytest.approx(1500.0)
+    assert merged["peers"]["pB"]["perf_now_us"] == pytest.approx(750.0)
+    assert merged["saturated"] == ["pA"]
+    assert merged["max_cpu_frac"] == pytest.approx(0.95)
+    assert resource.peer_saturated(merged, "pA") is True
+    assert resource.peer_saturated(merged, "pB") is False
+    assert resource.peer_saturated(merged, "pZ") is False
+    assert resource.peer_saturated(None, "pA") is False
+    empty = resource.merge_resources({}, {})
+    assert empty["peers"] == {} and empty["max_cpu_frac"] is None
+
+
+def _steps_with_critical(peer, edge):
+    return [{"critical": {"peer": peer, "edge": edge, "self_us": 1000.0}}]
+
+
+def _links(edges):
+    return {"edges": edges}
+
+
+def test_classify_cause_network_via_step_election():
+    cause, edge = classify_cause(
+        "pA", steps=_steps_with_critical("pA", "pB"), links=None,
+        resources=None,
+    )
+    assert (cause, edge) == ("network", ["pA", "pB"])
+
+
+def test_classify_cause_compute_outranks_link_matrix():
+    merged = resource.merge_resources(
+        {"pA": _doc("pA", 0.95, True)}, {}
+    )
+    links = _links({"pA": {"pB": {"bw": 5.0}}})
+    cause, edge = classify_cause("pA", steps=[], links=links,
+                                 resources=merged)
+    # live saturation measurement beats the matrix estimate
+    assert (cause, edge) == ("compute", None)
+
+
+def test_classify_cause_link_fallback_and_unknown():
+    links = _links({"pA": {"pB": {"bw": 5.0}, "pC": {"bw": 100.0}}})
+    cause, edge = classify_cause("pA", steps=[], links=links, resources=None)
+    assert cause == "network" and edge == ["pA", "pB"]
+    # no measurement at all: unknown, never a fabricated edge
+    assert classify_cause("pQ", steps=[], links=None, resources=None) \
+        == ("unknown", None)
+
+
+# ---------------------------------------------------------------------------
+# predictor clamp: gain <= 1 / compute_frac (the r12 86x fix)
+# ---------------------------------------------------------------------------
+
+def _shaped_matrix(k=4):
+    m = np.full((k, k), 100.0)
+    np.fill_diagonal(m, 0.0)
+    m[1, 2] = 1.0
+    m[1, :] *= 0.5
+    m[1, 1] = 0.0
+    return m
+
+
+def test_derive_plan_clamped_by_compute_floor():
+    from kungfu_tpu.plan import replan as rp
+
+    m = _shaped_matrix()
+    raw = rp.derive_plan(m, mode="auto")
+    assert raw is not None and raw.gain > 1.0
+
+    for cf in (0.25, 0.5, 0.9, 1.0):
+        plan = rp.derive_plan(m, mode="auto", compute_frac=cf)
+        assert plan.gain <= 1.0 / cf + 1e-6, (cf, plan.gain)
+        assert plan.gain == pytest.approx(
+            round(min(raw.gain, 1.0 / cf), 6)
+        )
+        # the clamp changes only the prediction, never the plan bytes
+        assert plan.order == raw.order
+
+    # unmeasured (0.0) and garbage floors never clamp
+    assert rp.derive_plan(m, mode="auto").gain == raw.gain
+    assert rp.derive_plan(m, mode="auto", compute_frac=0.0).gain == raw.gain
+    assert rp.derive_plan(
+        m, mode="auto", compute_frac=float("nan")
+    ).gain == raw.gain
+    # a floor above 1.0 saturates at 1.0 (gain can never clamp below 1x)
+    assert rp.derive_plan(m, mode="auto", compute_frac=5.0).gain \
+        == pytest.approx(min(raw.gain, 1.0))
+
+
+def test_clamped_prediction_agrees_with_ledger_scale():
+    """The acceptance property at unit scale: with a measured compute
+    floor cf, the clamped prediction can never exceed the realizable
+    Amdahl ceiling 1/cf — so a realized gain of exactly the ceiling is
+    within 1x of the prediction (r12's raw predictor was 86x off)."""
+    from kungfu_tpu.plan import replan as rp
+
+    cf = 0.8  # a compute-shaped peer: at most 1.25x realizable
+    plan = rp.derive_plan(_shaped_matrix(), mode="auto", compute_frac=cf)
+    realized_ceiling = 1.0 / cf
+    assert plan.gain <= realized_ceiling + 1e-6
+    assert plan.gain / realized_ceiling <= 1.0 + 1e-6
+
+
+# ---------------------------------------------------------------------------
+# aggregator integration: live endpoints, health summary, cause caching
+# ---------------------------------------------------------------------------
+
+from kungfu_tpu.telemetry import audit  # noqa: E402
+from kungfu_tpu.telemetry import cluster as tcluster  # noqa: E402
+from kungfu_tpu.telemetry.http import TelemetryServer  # noqa: E402
+
+
+class FakeWorker:
+    def __init__(self, step_time_s):
+        self.step_time_s = step_time_s
+        self.registry = metrics.Registry()
+        self._steps = self.registry.counter(
+            "kungfu_steps_total", "Training steps completed"
+        )
+        self._hist = self.registry.histogram(
+            "kungfu_step_duration_seconds", "Wall-clock duration per step"
+        )
+        self._bw = self.registry.gauge(
+            "kungfu_link_bandwidth_bytes_per_second", "bw", ("dst",)
+        )
+        self.server = TelemetryServer(
+            0, host="127.0.0.1", registry=self.registry
+        )
+        self.server.start()
+        self.label = f"127.0.0.1:{self.server.port}"
+        self.url = f"http://127.0.0.1:{self.server.port}"
+
+    def step(self, n=5):
+        for _ in range(n):
+            self._steps.inc()
+            self._hist.observe(self.step_time_s)
+
+    def link(self, dst, bw):
+        self._bw.labels(dst=dst).set(bw)
+
+    def stop(self):
+        self.server.stop()
+
+
+def _cluster(step_times):
+    workers = [FakeWorker(s) for s in step_times]
+    agg = tcluster.TelemetryAggregator(
+        interval=0.1, registry=metrics.Registry()
+    )
+    agg.set_peers([(w.label, w.url) for w in workers])
+    return workers, agg
+
+
+def _run_scrapes(workers, agg, rounds=2):
+    for _ in range(rounds):
+        for w in workers:
+            w.step()
+        agg.scrape_once()
+
+
+def test_live_np2_cluster_resources_and_health_summary():
+    resource.reset_plane()
+    workers, agg = _cluster([0.05, 0.05])
+    try:
+        _run_scrapes(workers, agg)
+        doc = agg.cluster_resources()
+        # both endpoints served the process-global plane's document
+        assert doc["count"] == 2
+        assert sorted(doc["peers"]) == sorted(w.label for w in workers)
+        for row in doc["peers"].values():
+            assert "cpu_frac" in row and "buckets" in row
+        health = agg.cluster_health()
+        res = health["resources"]
+        assert res is not None
+        assert sorted(res["peers"]) == sorted(w.label for w in workers)
+        for row in res["peers"].values():
+            assert set(row) == {
+                "cpu_frac", "train_frac", "engine_frac", "saturated"
+            }
+        # unflagged peers serve a null cause, never a fabricated one
+        for p in health["peers"].values():
+            assert p["straggler_cause"] is None
+    finally:
+        agg.stop()
+        for w in workers:
+            w.stop()
+        resource.reset_plane()
+
+
+def test_straggler_cause_compute_cached_and_served(monkeypatch):
+    """A flagged peer the resource plane reports saturated classifies
+    cause=compute at the flag TRANSITION, lands on the audit event, and
+    is served per-peer on /cluster/health until the flag clears."""
+    resource.reset_plane()
+    workers, agg = _cluster([0.05, 0.05, 0.05, 0.75])
+    slow = workers[-1].label
+    real_merge = resource.merge_resources
+
+    def saturating_merge(docs, offsets):
+        merged = real_merge(docs, offsets)
+        row = merged["peers"].get(slow)
+        if row is not None:
+            row["saturated"] = True
+            merged["saturated"] = [slow]
+        return merged
+
+    monkeypatch.setattr(resource, "merge_resources", saturating_merge)
+    audit.clear()
+    try:
+        _run_scrapes(workers, agg)
+        health = agg.cluster_health()
+        assert health["stragglers"] == [slow]
+        assert health["peers"][slow]["straggler_cause"] == "compute"
+        events = audit.records(kind="straggler")
+        assert len(events) == 1
+        assert events[0].detail["cause"] == "compute"
+        assert agg._causes == {slow: "compute"}
+    finally:
+        audit.clear()
+        agg.stop()
+        for w in workers:
+            w.stop()
+        resource.reset_plane()
+
+
+def test_straggler_cause_network_via_link_matrix():
+    """A flagged peer with a measured slow edge touching it (and no
+    saturation) classifies cause=network carrying that edge."""
+    resource.reset_plane()
+    workers, agg = _cluster([0.05, 0.05, 0.05, 0.75])
+    slow = workers[-1].label
+    # the fast peers see a congested edge toward the slow peer; every
+    # other measured edge is healthy
+    workers[0].link(slow, 1e3)
+    workers[0].link(workers[1].label, 1e9)
+    workers[1].link(workers[2].label, 1e9)
+    audit.clear()
+    try:
+        _run_scrapes(workers, agg)
+        health = agg.cluster_health()
+        assert health["stragglers"] == [slow]
+        assert health["peers"][slow]["straggler_cause"] == "network"
+        events = audit.records(kind="straggler")
+        assert len(events) == 1
+        assert events[0].detail["cause"] == "network"
+        assert events[0].detail["blocking_edge"] == [workers[0].label, slow]
+    finally:
+        audit.clear()
+        agg.stop()
+        for w in workers:
+            w.stop()
+        resource.reset_plane()
+
+
+def test_cleared_straggler_drops_cached_cause():
+    agg = tcluster.TelemetryAggregator(
+        interval=0.1, registry=metrics.Registry()
+    )
+    agg._flagged = {"pGone"}
+    agg._causes = {"pGone": "compute"}
+    audit.clear()
+    try:
+        agg._publish()
+        assert agg._causes == {}
+        cleared = audit.records(kind="straggler_cleared")
+        assert [r.peer for r in cleared] == ["pGone"]
+    finally:
+        audit.clear()
+        agg.stop()
+
+
+# ---------------------------------------------------------------------------
+# rendering: info resources / info top / postmortem
+# ---------------------------------------------------------------------------
+
+def test_render_resources_table():
+    merged = resource.merge_resources(
+        {
+            "pA": _doc("pA", 0.95, True),
+            "pB": _doc("pB", 0.30, False),
+            "pC": {"peer": "pC", "supported": False},
+        },
+        {},
+    )
+    lines = resource.render_resources(merged)
+    assert lines[0].startswith("PEER")
+    assert "CPU%" in lines[0] and "TRAIN%" in lines[0]
+    rowA = next(l for l in lines if l.startswith("pA"))
+    assert "95" in rowA and "SATURATED" in rowA
+    rowC = next(l for l in lines if l.startswith("pC"))
+    assert "unsupported" in rowC
+    assert "compute-saturated: pA" in lines[-1]
+    assert "max cpu 95%" in lines[-1]
+
+
+def test_render_worker_resources_postmortem_shape():
+    doc = _doc("pA", 0.95, True)
+    doc["profile"] = {"main_engine_frac": 0.75}
+    lines = resource.render_worker_resources(doc)
+    assert "SATURATED (compute-bound at death)" in lines[0]
+    assert any("train" in l and "s total" in l for l in lines)
+    assert any("75% of samples blocked in the engine" in l for l in lines)
+    assert resource.render_worker_resources({}) == ["no resource data"]
+
+
+def test_info_render_top_carries_resource_columns():
+    from kungfu_tpu.info.__main__ import render_top
+
+    health = {
+        "peers": {
+            "pA": {"straggler": True, "straggler_cause": "compute",
+                   "error": None},
+            "pB": {"straggler": True, "straggler_cause": "unknown",
+                   "error": None},
+            "pC": {"straggler": False, "straggler_cause": None,
+                   "error": None},
+        },
+        "stragglers": ["pA", "pB"],
+        "resources": {
+            "peers": {
+                "pA": {"cpu_frac": 0.93, "train_frac": 0.6,
+                       "engine_frac": 0.3, "saturated": True},
+            },
+            "saturated": ["pA"],
+            "max_cpu_frac": 0.93,
+        },
+    }
+    out = render_top(health)
+    assert "CPU%" in out and "TRAIN%" in out
+    assert "STRAGGLER(compute)" in out
+    # an unknown cause renders the bare flag, not STRAGGLER(unknown)
+    assert "STRAGGLER(unknown)" not in out
+    assert "93%" in out and "60%" in out
+    assert "compute-saturated: pA" in out
+
+
+def test_info_render_resources_and_json(capsys):
+    from kungfu_tpu.info import __main__ as info_main
+
+    merged = resource.merge_resources({"pA": _doc("pA", 0.5, False)}, {})
+    out = info_main.render_resources(merged)
+    assert "PEER" in out and "pA" in out
+    assert "no resource documents" in info_main.render_resources(
+        {"peers": {}}
+    )
+    # --json renders the raw payload (scripting/CI contract)
+    fn = info_main._json_flag(["--json"], info_main.render_resources)
+    assert json.loads(fn(merged))["peers"]["pA"]["cpu_frac"] == 0.5
+
+
+def test_info_resources_requires_url(monkeypatch, capsys):
+    from kungfu_tpu.info import __main__ as info_main
+
+    monkeypatch.delenv("KF_CLUSTER_HEALTH_URL", raising=False)
+    assert info_main._cmd_resources([]) == 2
+    assert "/cluster/resources" in capsys.readouterr().err
+
+
+def test_flight_snapshot_carries_resource_tail(tmp_path):
+    from kungfu_tpu.telemetry import flight
+
+    resource.reset_plane()
+    try:
+        rec = flight.FlightRecorder(
+            str(tmp_path / "w9"), peer="w9",
+            enable_faulthandler=False, install_signal_handlers=False,
+        )
+        rec.snapshot()
+        rec.close(reason="test")
+        pm = flight.harvest_postmortem(str(tmp_path), "w9", exit_code=-9)
+        assert pm["last_resources"], "snapshot must journal the attribution"
+        assert "buckets" in pm["last_resources"]
+        out = flight.render_postmortem(pm)
+        if pm["last_resources"].get("supported"):
+            assert "final CPU attribution" in out
+    finally:
+        resource.reset_plane()
+
+
+# ---------------------------------------------------------------------------
+# KF605 signal-doc lint fixtures
+# ---------------------------------------------------------------------------
+
+def _signal_project(tmp_path, source, doc_rows):
+    from kungfu_tpu.devtools.kfcheck import core
+
+    docs = tmp_path / "docs"
+    docs.mkdir(exist_ok=True)
+    table = "\n".join(
+        ["## Policy signal table", "", "| Key | Written by | Meaning |",
+         "|---|---|---|"]
+        + [f"| `{n}` | x | y |" for n in doc_rows]
+        + ["", "## Next section"]
+    )
+    (tmp_path / "docs" / "telemetry.md").write_text(table)
+    ctx = core.FileContext(
+        str(tmp_path / "x.py"), "kungfu_tpu/x.py", textwrap.dedent(source)
+    )
+    return core.Project("kungfu_tpu", str(tmp_path), [ctx])
+
+
+# key names are letter-only: the scan's key regex is ^[a-z_]+/[a-z_]+$
+_SIG_NAMES = ("aa", "bb", "cc", "dd", "ee", "ff", "gg", "hh", "ii", "jj",
+              "kk")
+_SIG_ROWS = [f"fix/key_{n}" for n in _SIG_NAMES]
+
+_MANY_SIGNALS = textwrap.dedent("""
+    def signals(self):
+        out = {"fix/key_aa": 1, "fix/key_bb": 2}
+        out["fix/key_cc"] = 3
+        return out
+
+    def health_signals():
+        return {
+            "fix/key_dd": 1, "fix/key_ee": 2, "fix/key_ff": 3,
+            "fix/key_gg": 4, "fix/key_hh": 5, "fix/key_ii": 6,
+        }
+
+    def apply(ctx):
+        ctx.metrics["fix/key_jj"] = 1
+        ctx.metrics["fix/key_kk"] = 2
+""")
+
+
+def test_kf605_undocumented_key_flagged(tmp_path):
+    from kungfu_tpu.devtools.kfcheck import rules as R
+
+    src = _MANY_SIGNALS + '\ndef g(ctx):\n    ctx.metrics["fix/newkey"] = 1\n'
+    p = _signal_project(tmp_path, src, _SIG_ROWS + sorted(R._SIGNAL_INDIRECT))
+    out = R.check_signals_documented(p)
+    assert [f.rule for f in out] == ["KF605"]
+    assert "fix/newkey" in out[0].message
+
+
+def test_kf605_ghost_row_flagged(tmp_path):
+    from kungfu_tpu.devtools.kfcheck import rules as R
+
+    p = _signal_project(
+        tmp_path, _MANY_SIGNALS,
+        _SIG_ROWS + sorted(R._SIGNAL_INDIRECT) + ["fix/stale"],
+    )
+    out = R.check_signals_documented(p)
+    assert [f.rule for f in out] == ["KF605"]
+    assert "fix/stale" in out[0].message
+
+
+def test_kf605_clean_and_non_signal_writes_ignored(tmp_path):
+    from kungfu_tpu.devtools.kfcheck import rules as R
+
+    src = _MANY_SIGNALS + textwrap.dedent("""
+        def unrelated(self):
+            d = {}
+            d["not_namespaced"] = 1     # no '/': not a signal key
+            cache["some/key"] = 2       # not .metrics, not a signal fn
+            return d
+    """)
+    p = _signal_project(tmp_path, src, _SIG_ROWS + sorted(R._SIGNAL_INDIRECT))
+    assert R.check_signals_documented(p) == []
+
+
+def test_kf605_broken_scan_guard(tmp_path):
+    from kungfu_tpu.devtools.kfcheck import rules as R
+
+    p = _signal_project(
+        tmp_path,
+        'def signals(self):\n    return {"one/key": 1}\n',
+        ["one/key"],
+    )
+    out = R.check_signals_documented(p)
+    assert [f.rule for f in out] == ["KF605"]
+    assert "looks broken" in out[0].message
+
+
+def test_kf605_missing_table_section(tmp_path):
+    from kungfu_tpu.devtools.kfcheck import core
+    from kungfu_tpu.devtools.kfcheck import rules as R
+
+    docs = tmp_path / "docs"
+    docs.mkdir()
+    (docs / "telemetry.md").write_text("# no signal table here\n")
+    ctx = core.FileContext(
+        str(tmp_path / "x.py"), "kungfu_tpu/x.py", _MANY_SIGNALS
+    )
+    out = R.check_signals_documented(
+        core.Project("kungfu_tpu", str(tmp_path), [ctx])
+    )
+    assert [f.rule for f in out] == ["KF605"]
+    assert "Policy signal table" in out[0].message
