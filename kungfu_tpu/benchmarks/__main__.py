@@ -758,6 +758,71 @@ def report_resources(model: str) -> None:
         )
 
 
+def report_memory(model: str) -> None:
+    """The --memory report (ISSUE 17): where this worker's RSS actually
+    sits after the bench, from the memory plane's registered
+    accountants. Rank 0 only; reads this worker's own plane (the bench
+    has no aggregator). Riding the --zero A/B this is the
+    paper-replication number measured rather than computed: the
+    ``zero_state`` bucket holds the sharded session's live shard bytes
+    (1/k momentum + f32 shard masters), straight from the accountant
+    the session registered — the STATE line's claim, asserted from the
+    plane that the autoscaler actually consults."""
+    from kungfu_tpu import api
+    from kungfu_tpu.telemetry import memory as tmemory
+
+    if api.current_rank() != 0:
+        return
+    plane = tmemory.get_plane()
+    if not plane.supported():
+        log.echo(
+            f"MEMORY {model}: /proc RSS accounting unsupported on this "
+            "platform"
+        )
+        return
+    plane.maybe_sweep(force=True)
+    doc = plane.export()
+    rss = doc.get("rss_bytes")
+    if not rss:
+        log.echo(f"MEMORY {model}: no RSS sample (plane came up late?)")
+        return
+    limit = doc.get("limit_bytes")
+    hf = doc.get("headroom_frac")
+    buckets = doc.get("buckets") or {}
+    parts = ", ".join(
+        f"{b} {tmemory.fmt_bytes(info['bytes'])} ({info['frac']:.0%})"
+        for b in tmemory.BUCKETS
+        for info in [buckets.get(b) or {}]
+        if info.get("bytes")
+    )
+    log.echo(
+        f"MEMORY {model}: rss {tmemory.fmt_bytes(rss)}"
+        + (f" of {tmemory.fmt_bytes(limit)} limit" if limit else "")
+        + (
+            f" ({hf:.0%} headroom)"
+            if isinstance(hf, (int, float)) else ""
+        )
+        + (f" [{parts}]" if parts else "")
+    )
+    zero_names = {
+        name: nbytes
+        for name, nbytes in (doc.get("accountants") or {}).items()
+        if name.startswith("zero:")
+    }
+    for name, nbytes in sorted(zero_names.items()):
+        log.echo(
+            f"MEMORY {model}: sharded optimizer state ({name}): "
+            f"{tmemory.fmt_bytes(nbytes)} per peer, measured from the "
+            "plane's accountant (1/k momentum + f32 shard masters)"
+        )
+    leaks = doc.get("leak_suspects") or []
+    if leaks:
+        log.echo(
+            f"MEMORY {model}: LEAK SUSPECTS over the bench window: "
+            + ", ".join(leaks)
+        )
+
+
 def bench_host(model: str, iters: int, warmup: int = 4) -> None:
     from kungfu_tpu import api
     from kungfu_tpu.models.fake import fake_gradients
@@ -978,6 +1043,15 @@ def main() -> None:
         "KF_BENCH_RESOURCES=1 in the harness mirrors it)",
     )
     p.add_argument(
+        "--memory", action="store_true", dest="memory_report",
+        help="HOST only: after the bench, print the MEMORY report — the "
+        "memory plane's RSS decomposition over the registered byte "
+        "accountants (arena/pool/zero_state/sched_inflight/telemetry/"
+        "untracked) plus headroom against the effective limit; riding "
+        "--zero it reports the sharded optimizer-state bytes MEASURED "
+        "from the plane (KF_BENCH_MEMORY=1 in the harness mirrors it)",
+    )
+    p.add_argument(
         "--passes", type=int, default=16,
         help="HOST --async only: simulated-backprop passes per tensor "
         "(compute:comm ratio of the A/B; 16 is a conservative LOW bound "
@@ -1016,12 +1090,12 @@ def main() -> None:
     if args.method != "HOST" and (
         args.algo or args.wire or args.wire_ab or args.async_ab
         or args.zero_ab or args.steps_report or args.replan_ab
-        or args.resources_report
+        or args.resources_report or args.memory_report
     ):
         # the default method is XLA: silently measuring the wrong plane
         # is worse than an error
         p.error("--algo/--wire/--wire-ab/--async/--zero/--replan/--steps/"
-                "--resources only apply to --method HOST")
+                "--resources/--memory only apply to --method HOST")
     if sum(1 for f in (args.wire_ab, args.async_ab, args.zero_ab,
                        args.replan_ab) if f) > 1:
         p.error("--wire-ab/--async/--zero/--replan are separate A/Bs — "
@@ -1063,6 +1137,12 @@ def main() -> None:
             from kungfu_tpu.telemetry import resource as _tres
 
             _tres.get_plane().maybe_sweep(force=True)
+        if args.memory_report:
+            # same anchor for the memory plane: the baseline sweep gives
+            # the trend/leak windows a pre-bench starting point
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            _tmem.get_plane().maybe_sweep(force=True)
     if args.method == "XLA":
         bench_xla(args.model, args.iters)
     elif args.method == "P2P":
@@ -1084,6 +1164,8 @@ def main() -> None:
         report_steps(args.model)
     if args.method == "HOST" and args.resources_report:
         report_resources(args.model)
+    if args.method == "HOST" and args.memory_report:
+        report_memory(args.model)
 
 
 if __name__ == "__main__":

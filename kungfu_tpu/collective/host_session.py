@@ -331,11 +331,45 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         (ISSUE 11). `auto` resolves to on for multi-peer sessions (a
         cluster of one has nothing to shard). Cluster-agreed — the mode
         decides the step's rendezvous dataflow, so it rides the knob
-        consensus like KF_CONFIG_ASYNC."""
+        consensus like KF_CONFIG_ASYNC.
+
+        The memory plane (ISSUE 17) is CONSULTED here but deliberately
+        cannot flip the resolution: `engine_knobs()` carries the mode
+        string, not the resolved boolean, so two peers resolving
+        `auto` differently from their own live RSS would sail through
+        the consensus check and deadlock on mismatched rendezvous
+        dataflow. The consult is therefore advisory — when `auto`
+        resolves OFF (single peer) while this worker's measured
+        headroom sits at/below the pressure line, it logs that sharding
+        would have relieved the replicated optimizer state — and the
+        BEHAVIOURAL consumer of measured headroom is the rank-0-local
+        elastic grow gate (elastic/schedule.py), where a single
+        decision maker is safe."""
         if self.zero_mode == "on":
             return True
         if self.zero_mode == "auto":
-            return self.size >= 2
+            on = self.size >= 2
+            if not on and not getattr(self, "_zero_mem_advised", False):
+                self._zero_mem_advised = True  # one advisory per session
+                try:
+                    from kungfu_tpu.telemetry import log
+                    from kungfu_tpu.telemetry import memory as tmem
+
+                    sig = tmem.get_plane().signals()
+                    if sig.get("memory/pressure"):
+                        log.warn(
+                            "zero=auto resolved off (single peer) under "
+                            "measured memory pressure (headroom %.0f%%): "
+                            "replicated optimizer state is a candidate — "
+                            "grow the cluster or set KF_CONFIG_ZERO=on "
+                            "fleet-wide",
+                            100.0 * float(sig.get("memory/headroom_frac", 0)),
+                        )
+                # kfcheck: disable=KF400 — advisory log only; a failed
+                # headroom read must never block auto resolution
+                except Exception:  # noqa: BLE001
+                    pass
+            return on
         return False
 
     def scheduler(self) -> "CollectiveScheduler":

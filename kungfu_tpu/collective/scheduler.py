@@ -75,6 +75,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Tuple
 
 import numpy as np
@@ -177,6 +178,7 @@ class CollectiveScheduler:
         self._gather_outstanding = 0
         self._busy_s = 0.0  # pack+walk+gather+unpack seconds this round
         self._queued = 0  # units packed but not yet unpacked (gauge)
+        self._inflight_bytes = 0  # payload bytes of those queued units
         # lifetime stats (for the bench OVERLAP report)
         self._stat = {
             "rounds": 0, "units": 0, "buckets": 0, "zero_units": 0,
@@ -205,6 +207,35 @@ class CollectiveScheduler:
             self._queued_gauge = None
             self._overlap_ctr = None
             self._flush_wait_ctr = None
+        # memory plane (ISSUE 17): in-flight unit payloads are the
+        # scheduler's share of RSS. Weakref — the registry must never
+        # pin a closed scheduler epoch past its resize.
+        try:
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=weakref.ref(self)) -> Optional[int]:
+                sched = ref()
+                return (
+                    sched.inflight_bytes() if sched is not None else None
+                )
+
+            _tmem.register_accountant(
+                f"scheduler:e{self.epoch_id}", "sched_inflight", _acct
+            )
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the engine
+        except Exception:  # noqa: BLE001
+            pass
+
+    def inflight_bytes(self) -> int:
+        """Payload bytes of units packed but not yet unpacked (the
+        memory plane's `sched_inflight` bucket)."""
+        with self._cond:
+            return self._inflight_bytes
+
+    def _unit_nbytes(self, unit) -> int:
+        meta = self._unit_meta.get(unit.index)
+        return meta[2] if meta else 0
 
     # ------------------------------------------------------------------
     # public API
@@ -723,7 +754,10 @@ class CollectiveScheduler:
                             ),
                             None, None, members,
                         )
-                self._add_busy(time.perf_counter() - t0, queued=+1)
+                self._add_busy(
+                    time.perf_counter() - t0, queued=+1,
+                    nbytes=self._unit_nbytes(unit),
+                )
                 if not self._walkq.put((unit, lane, rnd, item)):
                     return  # aborted while the queue was full
         except BaseException as e:  # noqa: BLE001 - channeled to flush()
@@ -830,7 +864,9 @@ class CollectiveScheduler:
                     dt = time.perf_counter() - t0
                     if lane is not None:
                         lane.note_unpack(dt * 1e6)
-                    self._add_busy(dt, queued=-1)
+                    self._add_busy(
+                        dt, queued=-1, nbytes=-self._unit_nbytes(unit)
+                    )
                     with self._cond:
                         self._gather_outstanding -= 1
                         self._stat["units"] += 1
@@ -851,7 +887,9 @@ class CollectiveScheduler:
                 dt = time.perf_counter() - t0
                 if lane is not None:
                     lane.note_unpack(dt * 1e6)
-                self._add_busy(dt, queued=-1)
+                self._add_busy(
+                    dt, queued=-1, nbytes=-self._unit_nbytes(unit)
+                )
                 with self._cond:
                     self._grad_done += 1
                     self._stat["units"] += 1
@@ -861,11 +899,17 @@ class CollectiveScheduler:
         except BaseException as e:  # noqa: BLE001 - channeled to flush()
             self._record_error(e)
 
-    def _add_busy(self, seconds: float, queued: int = 0) -> None:
+    def _add_busy(
+        self, seconds: float, queued: int = 0, nbytes: int = 0
+    ) -> None:
         with self._cond:
             self._busy_s += seconds
             if queued:
                 self._queued += queued
+                # in-flight payload accounting rides the same mutation
+                # sites (pack=+, unpack=-) so the byte gauge can never
+                # drift from the unit gauge
+                self._inflight_bytes = max(0, self._inflight_bytes + nbytes)
             q = self._queued
         if queued and self._queued_gauge is not None:
             self._queued_gauge.set(q)

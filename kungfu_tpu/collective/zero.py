@@ -62,6 +62,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
@@ -306,6 +307,28 @@ class ShardedUpdateSession:
         else:
             self._state_gauge = None
             self._update_ctr = None
+        # memory plane (ISSUE 17): shard masters + optimizer state +
+        # the full-size reduce mirrors are long-lived buffer owners.
+        # Weakref so the registry never pins a session across an
+        # elastic resize — the entry self-drops when the session dies.
+        try:
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=weakref.ref(self)) -> Optional[int]:
+                zs = ref()
+                if zs is None:
+                    return None
+                return zs.state_bytes() + sum(
+                    b.W.nbytes for b in zs._buckets
+                )
+
+            _tmem.register_accountant(
+                f"zero:{name}", "zero_state", _acct
+            )
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the update path
+        except Exception:  # noqa: BLE001
+            pass
 
     def _add_bucket(self, names, params) -> None:
         total = int(sum(p.size for p in params))

@@ -77,7 +77,19 @@ class StepBasedSchedule:
         but then lost (config-server restart) is also covered: while the
         observed cluster size stays off-target, the proposal is re-sent
         every REPROPOSE_AFTER seconds (rate-limited so the steady
-        propose→consensus window doesn't spam the server)."""
+        propose→consensus window doesn't spam the server).
+
+        GROW proposals consult the memory plane first (ISSUE 17): a
+        bigger cluster re-replicates state across peers that may
+        already be near their limit, so while the acting rank 0's
+        MEASURED headroom sits at/below the pressure line the proposal
+        is deferred (re-checked every REPROPOSE_AFTER via the existing
+        rate limit). An unmeasured plane never defers — headroom that
+        was never observed must not block the schedule — and shrink
+        proposals always pass: shedding peers is how pressure gets
+        RELIEVED. The gate is rank-0-local by design: only the single
+        acting proposer decides, so divergent per-peer RSS can never
+        split an engine-knob consensus."""
         target = schedule_target(self.schedule, step)
         if target is None:
             return None
@@ -93,6 +105,33 @@ class StepBasedSchedule:
             # proposed recently: the resize flows through the config-server
             # consensus in es.end(); give it time to land
             return None
+        if target > api.cluster_size():
+            try:
+                from kungfu_tpu.telemetry import memory as tmem
+
+                ok, why = tmem.get_plane().grow_ok()
+            # kfcheck: disable=KF400 — a broken memory plane must
+            # never block a resize; fail open
+            except Exception:  # noqa: BLE001
+                ok, why = True, "plane unavailable"
+            if not ok:
+                from kungfu_tpu.telemetry import log, metrics
+
+                metrics.counter(
+                    "kungfu_memory_grow_deferrals_total",
+                    "Scheduled grow proposals deferred because the "
+                    "acting rank 0's measured memory headroom sat at "
+                    "or below the pressure line",
+                ).inc()
+                log.warn(
+                    "schedule: deferring grow to %d at progress %d: %s",
+                    target, step, why,
+                )
+                # rate-limit the re-check like a sent proposal so a
+                # pressured rank 0 logs once per window, not per step
+                self._last_proposed = target
+                self._proposed_at = time.monotonic()
+                return None
         try:
             api.propose_new_size(target)
         except OSError as e:

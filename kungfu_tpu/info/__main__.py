@@ -4,6 +4,7 @@
        python3 -m kungfu_tpu.info steps [--watch] [--json] [--interval S] [-n N] [URL]
        python3 -m kungfu_tpu.info decisions [--watch] [--json] [--interval S] [-n N] [URL]
        python3 -m kungfu_tpu.info resources [--watch] [--json] [--interval S] [URL]
+       python3 -m kungfu_tpu.info memory [--watch] [--json] [--interval S] [URL]
        python3 -m kungfu_tpu.info postmortem [DIR|URL]
 
 Prints framework, backend and cluster-env diagnostics (parity:
@@ -50,7 +51,15 @@ per-bucket busy split (train/walk/codec/sched/telemetry/other) and the
 compute-saturation flag. This is the "is this peer compute-bound or
 network-bound?" view — see the runbook in docs/telemetry.md.
 
-`--json` (top/links/steps/decisions/resources) emits the raw cluster
+`memory` renders the memory plane (ISSUE 17): every worker's RSS
+decomposition from the runner's /cluster/memory endpoint — per peer the
+RSS against its effective memory limit, the per-bucket byte split
+(arena/pool/zero_state/sched_inflight/telemetry/untracked), the RSS
+trend and headroom forecast, plus pressure/thrashing/leak flags. This
+is the "which worker is about to OOM, and what's eating it?" view —
+see the runbook in docs/telemetry.md.
+
+`--json` (top/links/steps/decisions/resources/memory) emits the raw cluster
 endpoint payload instead of the rendered table — one flag for
 scripting/CI, applied in the shared fetch loop.
 
@@ -250,14 +259,19 @@ def render_top(health: dict) -> str:
     come from the resource plane (ISSUE 16): the window CPU fraction of
     the peer's effective cores and the training loop's share of the
     busy window; a flagged straggler carries its measured cause
-    (STRAGGLER(network) vs STRAGGLER(compute))."""
+    (STRAGGLER(network) vs STRAGGLER(compute) vs STRAGGLER(memory)).
+    The MEM% and HEADROOM columns come from the memory plane (ISSUE
+    17): RSS as a share of the peer's effective memory limit, and the
+    forecast headroom fraction."""
     steps = health.get("steps") or {}
     crit_frac = steps.get("crit_frac") or {}
     crit_edge = steps.get("crit_edge") or {}
     res_peers = (health.get("resources") or {}).get("peers") or {}
+    mem_block = health.get("memory") or {}
+    mem_peers = mem_block.get("peers") or {}
     cols = ("PEER", "STEP/S", "P50(ms)", "P99(ms)", "TX", "RX",
-            "RTT(ms)", "AGE(s)", "CPU%", "TRAIN%", "CRIT%", "CRIT-EDGE",
-            "FLAGS")
+            "RTT(ms)", "AGE(s)", "CPU%", "TRAIN%", "MEM%", "HEADROOM",
+            "CRIT%", "CRIT-EDGE", "FLAGS")
     rows = [cols]
     peers = health.get("peers", {})
     for label in sorted(peers):
@@ -277,6 +291,9 @@ def render_top(health: dict) -> str:
         r = res_peers.get(label) or {}
         cpu = r.get("cpu_frac")
         train = r.get("train_frac")
+        m = mem_peers.get(label) or {}
+        used = m.get("used_frac")
+        headroom = m.get("headroom_frac")
         rows.append((
             label,
             _fmt_num(p.get("step_rate"), "{:.2f}"),
@@ -288,6 +305,8 @@ def render_top(health: dict) -> str:
             _fmt_num(p.get("last_scrape_age_s")),
             f"{cpu:.0%}" if isinstance(cpu, (int, float)) else "-",
             f"{train:.0%}" if isinstance(train, (int, float)) else "-",
+            f"{used:.0%}" if isinstance(used, (int, float)) else "-",
+            f"{headroom:.0%}" if isinstance(headroom, (int, float)) else "-",
             f"{cf:.0%}" if isinstance(cf, (int, float)) else "-",
             f"→{crit_edge[label]}" if label in crit_edge else "-",
             ",".join(flags) or "ok",
@@ -317,6 +336,15 @@ def render_top(health: dict) -> str:
     sat = (health.get("resources") or {}).get("saturated") or []
     if sat:
         summary += f"; compute-saturated: {', '.join(sat)}"
+    pressured = mem_block.get("pressure") or []
+    if pressured:
+        summary += f"; memory-pressured: {', '.join(pressured)}"
+    leaks = mem_block.get("leak_suspects") or {}
+    if leaks:
+        summary += "; leak suspects: " + ", ".join(
+            f"{peer}({','.join(buckets)})"
+            for peer, buckets in sorted(leaks.items())
+        )
     return "\n".join([summary] + lines)
 
 
@@ -596,6 +624,41 @@ def _cmd_resources(argv) -> int:
     )
 
 
+def render_memory(doc: dict) -> str:
+    """One frame of `info memory`: the merged per-peer RSS
+    decomposition table — rendering shared with the merge tests
+    (memory.render_memory) so the live view and tests read
+    identically."""
+    from kungfu_tpu.telemetry import memory as _tmem
+
+    if not (doc.get("peers") or {}):
+        return (
+            "no memory documents yet — workers publish /memory once "
+            "telemetry is on (kfrun -w) and a scrape has landed; RSS "
+            "accounting needs Linux (/proc)"
+        )
+    return "\n".join(_tmem.render_memory(doc))
+
+
+def _cmd_memory(argv) -> int:
+    watch = "--watch" in argv
+    interval, rc = _interval_flag(argv, "memory")
+    if rc is not None:
+        return rc
+    url = _cluster_url(argv, "/cluster/memory")
+    if not url:
+        print(
+            "info memory: no /cluster/memory URL — pass one (or a "
+            "runner debug endpoint), or run under kfrun -w -debug-port N "
+            "(which exports KF_CLUSTER_HEALTH_URL)",
+            file=sys.stderr,
+        )
+        return 2
+    return _fetch_render_loop(
+        "memory", url, _json_flag(argv, render_memory), watch, interval
+    )
+
+
 def _cmd_postmortem(argv) -> int:
     from kungfu_tpu.telemetry import flight
 
@@ -649,6 +712,8 @@ def main(argv) -> None:
         sys.exit(_cmd_decisions(argv[1:]))
     if argv and argv[0] == "resources":
         sys.exit(_cmd_resources(argv[1:]))
+    if argv and argv[0] == "memory":
+        sys.exit(_cmd_memory(argv[1:]))
     if argv and argv[0] == "postmortem":
         sys.exit(_cmd_postmortem(argv[1:]))
     _show_versions()

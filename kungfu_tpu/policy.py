@@ -45,6 +45,13 @@ per-thread CPU attribution, overridden by the cluster merge) and
 ``resource/saturated_peers`` (cluster-wide only) — the measured
 compute-side inputs that tell a policy whether a slow peer is
 network-bound (re-plan around it) or compute-bound (shed it).
+
+The memory plane (ISSUE 17) adds ``memory/headroom_frac`` +
+``memory/pressure`` + ``memory/leak_suspect`` (worker-local byte
+attribution and OOM-headroom forecast, overridden by the cluster
+merge) and ``memory/min_headroom_peer`` + ``memory/min_headroom_frac``
+(cluster-wide only) — the grow-gate inputs ROADMAP item 3's unattended
+autoscaler consults before proposing a bigger cluster.
 """
 
 from __future__ import annotations
@@ -146,6 +153,7 @@ class PolicyRunner:
             from kungfu_tpu.collective.host_session import get_walk_profiler
             from kungfu_tpu.telemetry import decisions as _tdec
             from kungfu_tpu.telemetry import link as _link
+            from kungfu_tpu.telemetry import memory as _tmem
             from kungfu_tpu.telemetry import resource as _tres
             from kungfu_tpu.telemetry import steptrace as _steptrace
 
@@ -156,7 +164,10 @@ class PolicyRunner:
                         "decision/last_kind", "decision/last_realized_gain",
                         "decision/regressed",
                         "resource/cpu_frac", "resource/engine_frac",
-                        "resource/saturated", "resource/saturated_peers"):
+                        "resource/saturated", "resource/saturated_peers",
+                        "memory/headroom_frac", "memory/pressure",
+                        "memory/leak_suspect", "memory/min_headroom_peer",
+                        "memory/min_headroom_frac"):
                 self.ctx.metrics.pop(key, None)
             if _link.enabled():
                 self.ctx.metrics.update(_link.get_table().signals())
@@ -173,6 +184,9 @@ class PolicyRunner:
             # attribution — the cluster merge overrides the shared
             # resource/* keys below when a runner aggregator is live
             self.ctx.metrics.update(_tres.get_plane().signals())
+            # memory plane (ISSUE 17): this worker's own headroom and
+            # leak verdicts — same cluster-override precedence
+            self.ctx.metrics.update(_tmem.get_plane().signals())
         except Exception as e:  # noqa: BLE001 - telemetry must never kill training
             log.debug("policy: walk/link signal refresh failed: %s", e)
         try:

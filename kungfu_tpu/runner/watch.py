@@ -59,6 +59,7 @@ class DebugServer:
     - ``/cluster/steps``   merged per-step critical-path records
     - ``/cluster/decisions`` merged adaptation-decision ledger
     - ``/cluster/resources`` merged per-thread CPU attribution view
+    - ``/cluster/memory``  merged per-subsystem byte attribution view
     - anything else        the Stage/worker debug dump (old contract)
     """
 
@@ -96,6 +97,11 @@ class DebugServer:
             if path == "/cluster/resources":
                 return (
                     json.dumps(agg.cluster_resources(), indent=2),
+                    "application/json",
+                )
+            if path == "/cluster/memory":
+                return (
+                    json.dumps(agg.cluster_memory(), indent=2),
                     "application/json",
                 )
             if path == "/cluster/audit":

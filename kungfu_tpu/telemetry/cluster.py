@@ -37,7 +37,12 @@ serves it from the watcher's debug endpoint:
   worker's ``/resources`` per-thread CPU attribution merged into one
   view with the saturated (compute-bound) peers elected — the input
   that lets straggler events carry ``cause=compute`` vs ``network``
-  and lets re-planning clamp predicted gains by the compute floor.
+  and lets re-planning clamp predicted gains by the compute floor;
+- ``/cluster/memory`` — the memory plane (ISSUE 17): every worker's
+  ``/memory`` bucket decomposition, headroom forecast and thrash flag
+  merged into one view with the minimum-headroom peer elected — the
+  grow-gate input the unattended autoscaler consults and the feed for
+  ``cause=memory`` straggler attribution.
 
 On top of the snapshot the aggregator runs straggler detection
 (:mod:`~kungfu_tpu.telemetry.straggler`): rolling per-peer step-time
@@ -59,12 +64,14 @@ import os
 import threading
 import time
 import urllib.request
+import weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import audit, log, metrics, promparse
 from kungfu_tpu.telemetry import decisions as tdecisions
 from kungfu_tpu.telemetry import link as tlink
+from kungfu_tpu.telemetry import memory as tmemory
 from kungfu_tpu.telemetry import resource as tresource
 from kungfu_tpu.telemetry import steptrace as tstep
 from kungfu_tpu.telemetry import straggler as tstraggler
@@ -330,6 +337,22 @@ class TelemetryAggregator:
         self._resources: dict = {}
         self._resources_at: Optional[float] = None  # monotonic
         self._resources_refresh_lock = threading.Lock()
+        # memory plane (ISSUE 17): same current-state contract as the
+        # resource plane — each refresh replaces the merged view
+        self._memory: dict = {}
+        self._memory_at: Optional[float] = None  # monotonic
+        self._memory_refresh_lock = threading.Lock()
+
+        # the aggregator's own tracked state is a long-lived buffer
+        # owner too: account it under the runner's `telemetry` bucket
+        # (weakref — the registry must never pin a stopped aggregator)
+        def _footprint(ref=weakref.ref(self)) -> Optional[int]:
+            agg = ref()
+            return agg.footprint_bytes() if agg is not None else None
+
+        self._mem_acct = tmemory.register_accountant(
+            "aggregator", "telemetry", _footprint
+        )
         self._g_step_overlap = reg.gauge(
             "kungfu_step_overlap_ratio",
             "Latest merged step's overlap fraction: scheduler-busy comm "
@@ -590,6 +613,10 @@ class TelemetryAggregator:
             self._refresh_resources()
         except Exception as e:  # noqa: BLE001 - the sweep must outlive a bad merge
             log.warn("cluster: resource-plane refresh failed: %s", e)
+        try:
+            self._refresh_memory()
+        except Exception as e:  # noqa: BLE001 - the sweep must outlive a bad merge
+            log.warn("cluster: memory-plane refresh failed: %s", e)
         self._publish()
         return self.cluster_health()
 
@@ -626,12 +653,14 @@ class TelemetryAggregator:
         links_doc = None
         steps: List[dict] = []
         resources: Optional[dict] = None
+        memory: Optional[dict] = None
         if newly_flagged:
             # measured attribution for the event (ISSUE 13 satellite +
-            # ISSUE 16 cause): the step plane's elected edge when this
-            # peer was recently critical, else the slowest link touching
-            # it, and the resource plane's saturation view — all inputs
-            # computed once per transition batch, never per peer
+            # ISSUE 16/17 causes): the step plane's elected edge when
+            # this peer was recently critical, else the memory plane's
+            # thrash flag, the resource plane's saturation view, else
+            # the slowest link touching it — all inputs computed once
+            # per transition batch, never per peer
             links_doc = tlink.merge_matrix(
                 {st.label: st.links for st in self.peers()},
                 copy_edges=False,
@@ -639,10 +668,11 @@ class TelemetryAggregator:
             with self._lock:
                 steps = list(self._steps)
                 resources = self._resources or None
+                memory = self._memory or None
         for peer in newly_flagged:
             sc = scores[peer]
             cause, edge = tstraggler.classify_cause(
-                peer, steps, links_doc, resources
+                peer, steps, links_doc, resources, memory
             )
             self._causes[peer] = cause
             log.warn(
@@ -702,6 +732,7 @@ class TelemetryAggregator:
         t, self._thread = self._thread, None
         if t is not None:
             t.join(self.timeout + 1.0)
+        self._mem_acct.close()
 
     # -- merged views ---------------------------------------------------
     def cluster_metrics(self) -> str:
@@ -1130,6 +1161,105 @@ class TelemetryAggregator:
                 "max_cpu_frac": merged.get("max_cpu_frac"),
             }
 
+    # -- memory plane (ISSUE 17) ----------------------------------------
+
+    def _refresh_memory(self) -> None:
+        """Pull every worker's /memory document, align the perf anchors
+        with the clock offsets already estimated for /cluster/trace and
+        REPLACE the merged view (current state, not a log: a vanished
+        peer's stale pressure flag must not keep gating resizes).
+        Whole refreshes serialize like the resource plane's."""
+        with self._memory_refresh_lock:
+            self._refresh_memory_locked()
+
+    def _refresh_memory_locked(self) -> None:
+        docs: Dict[str, dict] = {}
+        offsets: Dict[str, float] = {}
+        for st, body in self._fetch_all("/memory"):
+            try:
+                docs[st.label] = json.loads(body.decode())
+            except ValueError as e:
+                st.last_error = str(e)
+                continue
+            offsets[st.label] = st.clock_offset_us or 0.0
+        self._memory_at = time.monotonic()
+        merged = tmemory.merge_memory(docs, offsets)
+        with self._lock:
+            self._memory = merged
+
+    def cluster_memory(self) -> dict:
+        """The /cluster/memory view: every live worker's memory
+        attribution document merged NTP-aligned, plus the cluster
+        elections (minimum headroom + its peer, the pressure and
+        thrashing sets, leak suspects). Refreshes inline when the
+        cached merge is older than a scrape interval, so one-shot
+        consumers (`info memory` without a runner loop) still see
+        fresh attribution."""
+        now = time.monotonic()
+        if (
+            self._memory_at is None
+            or now - self._memory_at >= self.interval
+        ):
+            try:
+                self._refresh_memory()
+            except Exception as e:  # noqa: BLE001 - serve the cache over a 500
+                log.warn("cluster: inline memory refresh failed: %s", e)
+        with self._lock:
+            merged = dict(self._memory)
+        doc = {
+            "wall_time": time.time(),
+            "count": len(merged.get("peers") or {}),
+        }
+        doc.update(merged)
+        return doc
+
+    def _memory_summary(self) -> Optional[dict]:
+        """Compact memory signal for /cluster/health (the full
+        documents stay on /cluster/memory): per peer the used fraction,
+        headroom, thrash/pressure flags — exactly the columns `info
+        top` renders — plus the cluster elections."""
+        with self._lock:
+            merged = self._memory
+            if not merged or not merged.get("peers"):
+                return None
+            peers = {}
+            for label, doc in merged["peers"].items():
+                hf = doc.get("headroom_frac")
+                peers[label] = {
+                    "rss_bytes": doc.get("rss_bytes"),
+                    "headroom_frac": hf,
+                    "used_frac": (
+                        round(1.0 - hf, 6)
+                        if isinstance(hf, (int, float)) else None
+                    ),
+                    "pressure": bool(doc.get("pressure")),
+                    "thrashing": bool(doc.get("thrashing")),
+                }
+            return {
+                "peers": peers,
+                "min_headroom_frac": merged.get("min_headroom_frac"),
+                "min_headroom_peer": merged.get("min_headroom_peer"),
+                "pressure": list(merged.get("pressure") or []),
+                "thrashing": list(merged.get("thrashing") or []),
+                "leak_suspects": dict(merged.get("leak_suspects") or {}),
+            }
+
+    def footprint_bytes(self) -> int:
+        """The aggregator's OWN tracked-state footprint: deep size of
+        the link matrix, step ring, decision log and the merged
+        resource/memory views. This is the O(k^2)-worried state ROADMAP
+        item 2 needs bounded at scale — measured, and registered under
+        the `telemetry` bucket of the runner's own memory plane."""
+        with self._lock:
+            state = (
+                {st.label: st.links for st in self._peers.values()},
+                list(self._steps),
+                dict(self._decisions),
+                dict(self._resources),
+                dict(self._memory),
+            )
+        return tmemory.deep_sizeof(state)
+
     def _steps_summary(self) -> Optional[dict]:
         """Compact step signal for /cluster/health (the full records
         stay on /cluster/steps): the latest step's election plus each
@@ -1260,6 +1390,7 @@ class TelemetryAggregator:
             "links": self._links_summary(),
             "steps": self._steps_summary(),
             "resources": self._resources_summary(),
+            "memory": self._memory_summary(),
         }
 
 
@@ -1406,4 +1537,19 @@ def health_signals(
         signals["resource/saturated"] = bool(mine.get("saturated"))
     if res.get("saturated") is not None:
         signals["resource/saturated_peers"] = list(res["saturated"])
+    # memory plane (ISSUE 17): the cluster view of MY OWN headroom
+    # overrides the worker-local fallback on the shared memory/* keys;
+    # policies on any peer also see the cluster's weakest-headroom
+    # election — the grow-gate input
+    mem = snap.get("memory") or {}
+    mem_mine = (mem.get("peers") or {}).get(me) if me else None
+    if mem_mine:
+        if mem_mine.get("headroom_frac") is not None:
+            signals["memory/headroom_frac"] = mem_mine["headroom_frac"]
+            signals["memory/pressure"] = bool(mem_mine.get("pressure"))
+    if mem.get("min_headroom_peer") is not None:
+        signals["memory/min_headroom_peer"] = mem["min_headroom_peer"]
+        signals["memory/min_headroom_frac"] = mem.get("min_headroom_frac")
+    if mem.get("leak_suspects"):
+        signals["memory/leak_suspect"] = True
     return signals

@@ -50,6 +50,7 @@ from __future__ import annotations
 import math
 import threading
 import time
+import weakref as _weakref
 from collections import deque
 from typing import Dict, List, Optional
 
@@ -219,6 +220,36 @@ class DecisionLedger:
                 "decision kind and verdict (delivered/neutral/regressed)",
                 ("kind", "verdict"),
             )
+        # memory plane (ISSUE 17): rings report their cap so filling up
+        # never reads as a leak; only _open (unbounded until closed) can
+        # legitimately streak. Weakref — tests build throwaway ledgers.
+        try:
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=_weakref.ref(self)):
+                led = ref()
+                return led.footprint_bytes() if led is not None else None
+
+            _tmem.register_accountant("decisions", "telemetry", _acct)
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the ledger
+        except Exception:  # noqa: BLE001
+            pass
+
+    def footprint_bytes(self) -> int:
+        """Capacity estimate of the ledger's state in bytes (memory
+        plane `telemetry` bucket): ring caps plus live open records."""
+        from kungfu_tpu.telemetry import memory as _tmem
+
+        with self._lock:
+            ring = deque(self._ring, maxlen=self._ring.maxlen)
+            recent = deque(self._recent, maxlen=self._recent.maxlen)
+            open_ = list(self._open)
+        return (
+            _tmem.ring_cap_bytes(ring)
+            + _tmem.ring_cap_bytes(recent)
+            + _tmem.deep_sizeof(open_)
+        )
 
     # -- decision sites -------------------------------------------------
 

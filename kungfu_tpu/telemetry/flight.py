@@ -43,6 +43,7 @@ import time
 import zlib
 from typing import Dict, List, Optional, Tuple
 
+from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import audit, decisions, log, metrics, steptrace, tracing
 from kungfu_tpu.telemetry.config import env_truthy, truthy
 
@@ -393,6 +394,11 @@ class FlightRecorder:
             # died pegged at 100% telemetry CPU is a named finding, not
             # a mystery — the final CPU split rides every snapshot
             "resources": self._resources_doc(),
+            # the memory plane's decomposition (ISSUE 17): the last RSS
+            # breakdown + headroom trend rides every snapshot, so an
+            # OOM-killed worker's final record names the bucket that ate
+            # the budget instead of leaving a bare exit code -9
+            "memory": self._memory_doc(),
         }
         rec.update(extra)
         return rec
@@ -406,6 +412,19 @@ class FlightRecorder:
         # kfcheck: disable=KF400 — snapshot enrichment is best-effort:
         # a failed /proc sweep must cost the record one None field, not
         # the journal the whole snapshot
+        except Exception:  # noqa: BLE001
+            return None
+
+    @staticmethod
+    def _memory_doc() -> Optional[dict]:
+        try:
+            from kungfu_tpu.telemetry import memory as tmemory
+
+            plane = tmemory.get_plane()
+            plane.maybe_sweep(force=True)
+            return plane.export()
+        # kfcheck: disable=KF400 — same posture as _resources_doc: the
+        # memory tail is enrichment, never the reason a snapshot fails
         except Exception:  # noqa: BLE001
             return None
 
@@ -647,6 +666,7 @@ def harvest_postmortem(
         ),
         "last_decisions": (last.get("decisions") or []) if last else [],
         "last_resources": last.get("resources") if last else None,
+        "last_memory": last.get("memory") if last else None,
         "open_spans": (last.get("open_spans") or {}) if last else {},
         "audit_tail": (last.get("audit") or [])[-10:] if last else [],
         "log_tail": (last.get("log_tail") or [])[-20:] if last else [],
@@ -656,7 +676,32 @@ def harvest_postmortem(
         ) if d else None,
         "output_tail": list(output_tail or [])[-40:],
     }
+    pm["oom_suspected"] = oom_suspected(
+        pm.get("last_memory"), exit_code
+    )
     return pm
+
+
+def oom_suspected(last_memory: Optional[dict],
+                  exit_code: Optional[int]) -> bool:
+    """Did the kernel's OOM killer plausibly end this worker? True when
+    the final journalled RSS was within ``KF_MEMORY_OOM_MARGIN`` of the
+    measured memory limit, or the death was SIGKILL with the memory
+    trend still rising (the OOM killer's exact signature: -9 out of
+    nowhere while RSS climbs). A verdict, not a fact — the kernel logs
+    the real one in dmesg, which the worker can never report itself."""
+    mem = last_memory or {}
+    rss = mem.get("rss_bytes")
+    limit = mem.get("limit_bytes")
+    if rss and limit:
+        margin = float(knobs.get("KF_MEMORY_OOM_MARGIN"))
+        if rss >= limit * (1.0 - margin):
+            return True
+    if exit_code == -int(signal.SIGKILL):
+        trend = mem.get("trend_bytes_per_s")
+        if trend is not None and trend > 0:
+            return True
+    return False
 
 
 def _health_from_metrics(snap: Optional[dict]) -> dict:
@@ -776,6 +821,19 @@ def render_postmortem(pm: dict) -> str:
 
         lines.append("final CPU attribution (resource plane):")
         lines.extend(" " + l for l in _tres.render_worker_resources(res))
+    mem = pm.get("last_memory")
+    if mem:
+        from kungfu_tpu.telemetry import memory as _tmem
+
+        lines.append("final memory attribution (memory plane):")
+        lines.extend(" " + l for l in _tmem.render_worker_memory(mem))
+    if pm.get("oom_suspected"):
+        lines.append(
+            "⚠ OOM suspected: final RSS was at the memory limit (or the "
+            "death was SIGKILL while RSS was still climbing) — check the "
+            "buckets above for the consumer, and dmesg on the host for "
+            "the kernel's verdict"
+        )
     last_dec = pm.get("last_decisions") or []
     if last_dec:
         lines.append("final adaptation decisions (ledger tail):")

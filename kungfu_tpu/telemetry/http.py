@@ -16,7 +16,9 @@ serve the whole telemetry subsystem):
 - ``/decisions`` the decision ledger's adaptation records (ISSUE 15)
   with the same perf-clock anchors for the cluster merge;
 - ``/resources`` the resource attribution plane's per-bucket CPU
-  accounting + optional profiler aggregation (ISSUE 16), same anchors.
+  accounting + optional profiler aggregation (ISSUE 16), same anchors;
+- ``/memory`` the memory attribution plane's per-bucket byte
+  accounting + headroom forecast (ISSUE 17), same anchors.
 
 Shutdown is clean: ``stop()`` both shuts the serve loop down AND closes
 the listening socket, so a stopped peer never leaks its telemetry port
@@ -63,6 +65,13 @@ def _resources_doc() -> dict:
     return resource.get_plane().export()
 
 
+def _memory_doc() -> dict:
+    # lazy for the same reason: the plane's knobs resolve at first use
+    from kungfu_tpu.telemetry import memory
+
+    return memory.get_plane().export()
+
+
 class TelemetryServer:
     def __init__(
         self,
@@ -100,6 +109,10 @@ class TelemetryServer:
             ),
             "/resources": lambda: (
                 json.dumps(_resources_doc()),
+                "application/json",
+            ),
+            "/memory": lambda: (
+                json.dumps(_memory_doc()),
                 "application/json",
             ),
         }

@@ -38,6 +38,7 @@ from __future__ import annotations
 
 import os
 import threading
+import weakref as _weakref
 from typing import Dict, List, Optional, Sequence, Tuple
 
 from kungfu_tpu import knobs
@@ -150,6 +151,31 @@ class LinkTable:
                 "EWMA link latency from ping round trips",
                 ("dst",),
             )
+        # memory plane (ISSUE 17): the table is bounded by max_peers, so
+        # its report plateaus once the cluster is fully discovered —
+        # growth past that is a real leak. Weakref: tests build many
+        # throwaway tables; dead entries drop from the registry.
+        try:
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=_weakref.ref(self)):
+                tbl = ref()
+                return tbl.footprint_bytes() if tbl is not None else None
+
+            _tmem.register_accountant("link_table", "telemetry", _acct)
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the link table
+        except Exception:  # noqa: BLE001
+            pass
+
+    def footprint_bytes(self) -> int:
+        """Deep size of the per-destination estimator map (memory plane
+        `telemetry` bucket; bounded by KF_LINK_MAX_PEERS)."""
+        from kungfu_tpu.telemetry import memory as _tmem
+
+        with self._lock:
+            snap = dict(self._links)
+        return _tmem.deep_sizeof(snap)
 
     def _est(self, dst: str) -> Optional[LinkEstimator]:
         """Get-or-create under the table lock; None past the peer cap

@@ -40,6 +40,7 @@ import os
 import sys
 import threading
 import time
+import weakref as _weakref
 from collections import deque
 from typing import Callable, Dict, List, Optional, Tuple
 
@@ -395,6 +396,43 @@ class ResourcePlane:
         if hz > 0:
             self.profiler = SamplingProfiler(hz, keep)
             self.profiler.start()
+        # memory plane (ISSUE 17): the resource plane itself is a
+        # long-lived buffer owner (profiler ring + per-thread CPU
+        # tables) — accounted under `telemetry` like the other rings.
+        # Weakref so reset_plane() doesn't pin the old instance.
+        try:
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=_weakref.ref(self)):
+                plane = ref()
+                return (
+                    plane.footprint_bytes() if plane is not None else None
+                )
+
+            _tmem.register_accountant("resource_plane", "telemetry", _acct)
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the resource plane
+        except Exception:  # noqa: BLE001
+            pass
+
+    def footprint_bytes(self) -> int:
+        """Bytes held by the plane's bounded state (memory plane
+        `telemetry` bucket): profiler ring at CAP plus CPU tables."""
+        from kungfu_tpu.telemetry import memory as _tmem
+
+        with self.acct._lock:
+            acct_state = (
+                dict(self.acct._prev),
+                dict(self.acct._totals),
+                dict(self.acct._window),
+            )
+        total = _tmem.deep_sizeof((acct_state, dict(self._published)))
+        prof = self.profiler
+        if prof is not None:
+            with prof._lock:
+                ring = deque(prof._ring, maxlen=prof._ring.maxlen)
+            total += _tmem.ring_cap_bytes(ring)
+        return total
 
     def cores(self) -> float:
         if self._cores is None:

@@ -37,6 +37,7 @@ from __future__ import annotations
 
 import threading
 import time
+import weakref as _weakref
 from collections import deque
 from typing import Dict, List, Optional, Sequence, Tuple
 
@@ -316,6 +317,32 @@ class StepStore:
         self._lock = threading.Lock()
         self._sampler = _Sampler()
         self._stats = {"recorded": 0, "sampled_out": 0}
+        # memory plane (ISSUE 17): the ring is a long-lived buffer
+        # owner; it reports its CAP (mean item x maxlen) so filling up
+        # never looks like a leak. Weakref — reset_store() must not
+        # leave a pinned store behind.
+        try:
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=_weakref.ref(self)):
+                store = ref()
+                return store.footprint_bytes() if store is not None else None
+
+            _tmem.register_accountant("steptrace", "telemetry", _acct)
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the step store
+        except Exception:  # noqa: BLE001
+            pass
+
+    def footprint_bytes(self) -> int:
+        """Capacity estimate of the step ring in bytes (the memory
+        plane's `telemetry` bucket)."""
+        from kungfu_tpu.telemetry import memory as _tmem
+
+        with self._lock:
+            ring = list(self._ring)
+        cap = deque(ring, maxlen=self._ring.maxlen)
+        return _tmem.ring_cap_bytes(cap)
 
     def begin_step(self, epoch: int, round_: int) -> Optional[StepRecorder]:
         """Start recording one round, or None when the ring is disabled

@@ -171,30 +171,39 @@ def classify_cause(
     steps: Optional[List[dict]] = None,
     links: Optional[dict] = None,
     resources: Optional[dict] = None,
+    memory: Optional[dict] = None,
 ) -> Tuple[str, Optional[List[Optional[str]]]]:
-    """Name WHY a flagged peer is slow (ISSUE 16): ``(cause, edge)``
-    with cause in {network, compute, unknown}. Every cause is backed by
-    a measurement, never inferred from absence:
+    """Name WHY a flagged peer is slow (ISSUE 16 + 17): ``(cause,
+    edge)`` with cause in {network, memory, compute, unknown}. Every
+    cause is backed by a measurement, never inferred from absence:
 
     - the step plane elected this peer's edge as a recent critical
       path → **network** (the direct per-step measurement, strongest);
+    - the memory plane says the peer is thrashing (sustained major
+      page faults — its working set is paging off disk/swap) →
+      **memory** (a pegged CPU or a slow link is a SYMPTOM when every
+      access is a disk read, so this outranks the compute election);
     - the resource plane says the peer burned >= its saturation
       fraction of its effective cores → **compute** (a ring re-order
       or more bandwidth cannot speed up a pegged CPU);
     - otherwise, the slowest measured link touching the peer →
       **network** (weaker — a matrix estimate, not a step election —
-      so the live saturation measurement outranks it);
+      so the live thrash/saturation measurements outrank it);
     - no measurement at all → **unknown** with no fabricated edge.
 
-    ``resources`` is the merged /cluster/resources document (its
-    ``peers[peer]["saturated"]`` flag)."""
+    ``resources``/``memory`` are the merged /cluster/resources and
+    /cluster/memory documents (their ``peers[peer]["saturated"]`` and
+    ``peers[peer]["thrashing"]`` flags)."""
     for s in reversed(steps or []):
         c = s.get("critical")
         if c and str(c.get("peer")) == str(peer) and c.get("edge"):
             return "network", [str(peer), str(c["edge"])]
-    # lazy import: straggler is imported by the scorer-only paths too
+    # lazy imports: straggler is imported by the scorer-only paths too
+    from kungfu_tpu.telemetry import memory as tmemory
     from kungfu_tpu.telemetry import resource as tresource
 
+    if tmemory.peer_thrashing(memory, peer):
+        return "memory", None
     if tresource.peer_saturated(resources, peer):
         return "compute", None
     edge = blocking_edge(peer, steps=None, links=links)

@@ -162,6 +162,32 @@ class SenderArena:
         self._seq[1] = 0
         self._data = memoryview(self._mm)[HEADER:]
         self._alloc = 0  # mirrors _seq[0]; plain int avoids u64 churn
+        # memory plane (ISSUE 17): the mapped arena is a long-lived
+        # buffer owner — account it under the `arena` bucket for as
+        # long as the mapping lives. Report the touched high-water
+        # (header + bytes ever allocated, capped at capacity), not the
+        # mmap size: untouched tmpfs pages are not resident, and a
+        # tracked total above RSS would corrupt the `untracked`
+        # remainder. Best-effort, telemetry must never kill transport.
+        self._mem_acct = None
+        try:
+            import weakref as _weakref
+
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=_weakref.ref(self)):
+                a = ref()
+                if a is None:
+                    return None
+                return HEADER + min(a._alloc, a.capacity)
+
+            self._mem_acct = _tmem.register_accountant(
+                f"shm:{os.path.basename(path)}", "arena", _acct,
+            )
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the arena
+        except Exception:  # noqa: BLE001
+            pass
 
     def try_write(self, payload, nbytes: int) -> Optional[bytes]:
         """Copy `payload` into the ring; returns the packed descriptor, or
@@ -193,6 +219,9 @@ class SenderArena:
         return DESC.pack(start, nbytes, advance)
 
     def close(self) -> None:
+        if self._mem_acct is not None:
+            self._mem_acct.close()
+            self._mem_acct = None
         try:
             self._seq = None
             self._data.release()
@@ -246,6 +275,29 @@ class ReceiverArena:
         self._data = memoryview(self._mm)
         self._releaser = _OrderedReleaser(self._seq)
         self._recv_seq = 0  # bytes of (pad+len) seen, in frame order
+        # memory plane (ISSUE 17): the receiver maps the same pages —
+        # in ITS OWN process, so it accounts them too. Same high-water
+        # rule as the sender: only frames actually seen are resident
+        # here, not the whole mapping.
+        self._mem_acct = None
+        try:
+            import weakref as _weakref
+
+            from kungfu_tpu.telemetry import memory as _tmem
+
+            def _acct(ref=_weakref.ref(self)):
+                a = ref()
+                if a is None:
+                    return None
+                return HEADER + min(a._recv_seq, a.capacity)
+
+            self._mem_acct = _tmem.register_accountant(
+                f"shm:{os.path.basename(path)}", "arena", _acct,
+            )
+        # kfcheck: disable=KF400 — byte accounting is best-effort;
+        # it must never kill the arena
+        except Exception:  # noqa: BLE001
+            pass
 
     def region(self, offset: int, length: int, advance: int):
         """(memoryview of the payload, release() callable). Frames arrive
@@ -264,6 +316,9 @@ class ReceiverArena:
         return view, release
 
     def close(self) -> None:
+        if self._mem_acct is not None:
+            self._mem_acct.close()
+            self._mem_acct = None
         try:
             self._seq = None
             self._data.release()

@@ -101,6 +101,15 @@ class BufferPool:
         self._lock = threading.Lock()
         self._max = max_per_size
 
+    def cached_bytes(self) -> int:
+        """Bytes currently parked in the bins (the memory plane's
+        `pool` bucket; buffers checked out to callers are the caller's
+        RSS, not the pool's)."""
+        with self._lock:
+            return sum(
+                len(buf) for bin_ in self._bins.values() for buf in bin_
+            )
+
     def get(self, nbytes: int) -> bytearray:
         with self._lock:
             b = self._bins.get(nbytes)
@@ -120,3 +129,23 @@ _BUFFERS = BufferPool()
 
 def get_buffer_pool() -> BufferPool:
     return _BUFFERS
+
+
+def _register_pool_accountant() -> None:
+    # memory plane (ISSUE 17): the process-singleton buffer pool is a
+    # long-lived buffer owner; lazy import keeps utils free of a
+    # telemetry dependency at module load, best-effort because
+    # telemetry must never break the walk hot path
+    try:
+        from kungfu_tpu.telemetry import memory as _tmem
+
+        _tmem.register_accountant(
+            "buffer_pool", "pool", _BUFFERS.cached_bytes
+        )
+    # kfcheck: disable=KF400 — byte accounting is best-effort;
+    # it must never kill the pool
+    except Exception:  # noqa: BLE001
+        pass
+
+
+_register_pool_accountant()

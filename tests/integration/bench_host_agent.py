@@ -38,6 +38,8 @@ def main() -> None:
         argv += ["--steps"]
     if os.environ.get("KF_BENCH_RESOURCES", ""):
         argv += ["--resources"]
+    if os.environ.get("KF_BENCH_MEMORY", ""):
+        argv += ["--memory"]
     sys.argv = argv
     from kungfu_tpu.benchmarks.__main__ import main as bench_main
 

