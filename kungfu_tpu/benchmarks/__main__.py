@@ -992,6 +992,210 @@ def bench_gns(iters: int) -> None:
     )
 
 
+def bench_scrape(out_path: str = "BENCH_AGG_r15.json",
+                 sweeps: int = 5) -> None:
+    """Telemetry-plane scaling A/B (ISSUE 18): flat per-peer scraping
+    vs the scaled shapes (hierarchical digest fan-in + sampled link
+    matrix) against an in-process simulated fleet at k=64 and k=256.
+
+    The fleet sits behind the aggregator's injectable transport hook —
+    no sockets, so the A/B isolates exactly what the tentpole changes:
+    fan-out count (k fetches vs hosts digests), root-side exposition
+    parsing (k promparse passes vs pre-parsed digest docs), and the
+    /cluster/links document size (full merged matrix vs the rotated
+    sample + retained slowest edges). Writes the trajectory to
+    ``out_path`` and prints one RESULT line per k."""
+    import json
+    import os
+    import statistics
+
+    from kungfu_tpu.telemetry import cluster as tcluster
+    from kungfu_tpu.telemetry import decisions as tdecisions
+    from kungfu_tpu.telemetry import metrics as tmetrics
+    from kungfu_tpu.telemetry import steptrace as tsteptrace
+
+    per_host, neighbors = 16, 32
+    # plane documents every digest carries (hier ships these in-band;
+    # without them the root would fall back to per-worker plane fetches)
+    _store = tsteptrace.StepStore(keep=4)
+    for _r in (1, 2):
+        _rec = _store.begin_step(0, _r)
+        if _rec is not None:
+            _rec.finish(flush_wait_s=0.001, busy_s=0.04)
+    plane_docs = {
+        "steptrace": _store.export(peer="bench"),
+        "decisions": tdecisions.DecisionLedger(keep=4).export(),
+        "resources": {"peer": "bench", "wall_time_s": time.time()},
+        "memory": {"peer": "bench", "wall_time_s": time.time()},
+    }
+
+    def make_fetch(hosts):
+        labels = [
+            f"h{h:02d}:{9000 + i}"
+            for h in range(hosts) for i in range(per_host)
+        ]
+        k = len(labels)
+        pages, digests = {}, {}
+        # realistic exposition density: the full bucket ladder plus the
+        # four per-destination link families — the root-side promparse
+        # cost hier amortizes onto the per-host sub-aggregators
+        buckets = ("0.005", "0.01", "0.025", "0.05", "0.1", "0.25",
+                   "0.5", "1.0", "2.5", "5.0", "10.0", "+Inf")
+        for idx, label in enumerate(labels):
+            dsts = [labels[(idx + 1 + j) % k] for j in range(neighbors)]
+            lines = [
+                "# TYPE kungfu_steps_total counter",
+                "kungfu_steps_total 100",
+                "# TYPE kungfu_step_duration_seconds histogram",
+            ]
+            lines += [
+                f'kungfu_step_duration_seconds_bucket{{le="{le}"}} 100'
+                for le in buckets
+            ]
+            lines += [
+                "kungfu_step_duration_seconds_sum 5.0",
+                "kungfu_step_duration_seconds_count 100",
+                "# TYPE kungfu_collective_latency_seconds counter",
+                "kungfu_collective_latency_seconds 2.5",
+                "# TYPE kungfu_egress_bytes_total counter",
+                "kungfu_egress_bytes_total 1048576",
+                "# TYPE kungfu_ingress_bytes_total counter",
+                "kungfu_ingress_bytes_total 1048576",
+                "# TYPE kungfu_peer_rtt_seconds gauge",
+            ]
+            lines += [
+                f'kungfu_peer_rtt_seconds{{peer="{d}"}} 0.002'
+                for d in dsts[:4]
+            ]
+            for fam, val in (
+                (tcluster.LINK_BW, "1e8"),
+                (tcluster.LINK_LAT, "0.002"),
+                (tcluster.LINK_BYTES, "4194304"),
+                (tcluster.LINK_MSGS, "64"),
+            ):
+                lines.append(f"# TYPE {fam} gauge")
+                lines += [f'{fam}{{dst="{d}"}} {val}' for d in dsts]
+            lines += [
+                "# TYPE kungfu_topology_ring_position gauge",
+                f"kungfu_topology_ring_position {idx}",
+            ]
+            pages[label] = ("\n".join(lines) + "\n").encode()
+        for h in range(hosts):
+            host = f"h{h:02d}"
+            workers = {}
+            for i in range(per_host):
+                label = f"{host}:{9000 + i}"
+                text = pages[label].decode()
+                workers[label] = {
+                    "url": f"http://{host}:{9000 + i}",
+                    "metrics_text": text,
+                    "parsed": tcluster.parsed_to_doc(
+                        tcluster.parse_worker_page(text)
+                    ),
+                    "rtt_s": 1e-4,
+                    "clock_offset_us": 0.0,
+                    **plane_docs,
+                }
+            digests[host] = json.dumps({
+                "enabled": True, "host": host,
+                "wall_time": time.time(), "workers": workers,
+            }).encode()
+
+        plane_bodies = {
+            "/steptrace": json.dumps(plane_docs["steptrace"]).encode(),
+            "/decisions": json.dumps(plane_docs["decisions"]).encode(),
+            "/resources": json.dumps(plane_docs["resources"]).encode(),
+            "/memory": json.dumps(plane_docs["memory"]).encode(),
+        }
+
+        def fetch(base_url, path, timeout):
+            hostport = base_url.split("//", 1)[1]
+            endpoint = path.partition("?")[0]
+            if endpoint == tcluster.HOST_DIGEST_PATH:
+                return digests[hostport.split(":", 1)[0]], {}
+            if endpoint == "/metrics":
+                return pages[hostport], {}
+            body = plane_bodies.get(endpoint)
+            if body is None:
+                raise OSError(f"404 {endpoint}")
+            return body, {}
+
+        targets = [
+            (label, f"http://{label}") for label in labels
+        ]
+        return fetch, targets
+
+    def run(hosts, scale):
+        os.environ["KF_AGG_HIER_MIN_PEERS"] = "32" if scale else "0"
+        fetch, targets = make_fetch(hosts)
+        agg = tcluster.TelemetryAggregator(
+            interval=30.0, registry=tmetrics.Registry(), fetch=fetch
+        )
+        agg.set_peers(targets)
+        try:
+            times = []
+            for _ in range(sweeps):
+                t0 = time.perf_counter()
+                agg.scrape_once()
+                times.append(time.perf_counter() - t0)
+            links_bytes = len(json.dumps(agg.cluster_links()).encode())
+            mode = agg.plane_envelope()["mode"]
+        finally:
+            agg.stop()
+        return {
+            "mode": mode,
+            "sweep_s": round(statistics.median(times), 6),
+            "links_bytes": links_bytes,
+        }
+
+    from kungfu_tpu import knobs
+
+    saved = (
+        knobs.raw("KF_AGG_HIER_MIN_PEERS")
+        if knobs.is_set("KF_AGG_HIER_MIN_PEERS") else None
+    )
+    results = {}
+    try:
+        for hosts in (4, 16):  # k=64, k=256 at 16 workers/host
+            k = hosts * per_host
+            flat = run(hosts, scale=False)
+            scaled = run(hosts, scale=True)
+            entry = {
+                "hosts": hosts, "workers_per_host": per_host,
+                "link_neighbors": neighbors,
+                "flat": flat, "scale": scaled,
+                "sweep_speedup": round(
+                    flat["sweep_s"] / max(scaled["sweep_s"], 1e-9), 2
+                ),
+                "links_payload_ratio": round(
+                    flat["links_bytes"] / max(scaled["links_bytes"], 1), 2
+                ),
+            }
+            results[f"k{k}"] = entry
+            log.info(
+                "RESULT scrape k=%d: sweep %.1fms -> %.1fms (%.1fx), "
+                "/cluster/links %d B -> %d B (%.1fx), mode %s -> %s",
+                k, flat["sweep_s"] * 1e3, scaled["sweep_s"] * 1e3,
+                entry["sweep_speedup"], flat["links_bytes"],
+                scaled["links_bytes"], entry["links_payload_ratio"],
+                flat["mode"], scaled["mode"],
+            )
+    finally:
+        if saved is None:
+            os.environ.pop("KF_AGG_HIER_MIN_PEERS", None)
+        else:
+            os.environ["KF_AGG_HIER_MIN_PEERS"] = saved
+    doc = {
+        "bench": "telemetry-plane scrape A/B (ISSUE 18)",
+        "sweeps_per_config": sweeps,
+        "results": results,
+    }
+    with open(out_path, "w") as f:
+        json.dump(doc, f, indent=2, sort_keys=True)
+        f.write("\n")
+    log.info("RESULT scrape trajectory written to %s", out_path)
+
+
 def main() -> None:
     p = argparse.ArgumentParser("kungfu_tpu.benchmarks")
     p.add_argument("--method", choices=["XLA", "HOST", "P2P", "GNS"], default="XLA")
@@ -1086,7 +1290,24 @@ def main() -> None:
         "session comes up), report both medians, the drift-free speedup "
         "and the OVERLAP line (flush-wait vs walk time)",
     )
+    p.add_argument(
+        "--scrape", action="store_true", dest="scrape_ab",
+        help="standalone telemetry-plane A/B (ISSUE 18): flat per-peer "
+        "scraping vs hierarchical digests + sampled link matrix against "
+        "a simulated in-process fleet at k=64 and k=256; writes the "
+        "sweep-time and /cluster/links payload trajectory to "
+        "--scrape-out (no TPU, no kfrun needed)",
+    )
+    p.add_argument(
+        "--scrape-out", default="BENCH_AGG_r15.json",
+        help="output path for the --scrape trajectory JSON",
+    )
     args = p.parse_args()
+    if args.scrape_ab:
+        # pure-host telemetry bench: dispatch before any accelerator
+        # path (or HOST-flag validation) runs
+        bench_scrape(args.scrape_out)
+        return
     if args.method != "HOST" and (
         args.algo or args.wire or args.wire_ab or args.async_ab
         or args.zero_ab or args.steps_report or args.replan_ab

@@ -16,6 +16,9 @@ set ONCE (per-file cache and all) and sections the report by concern:
   audit kinds (KF604, ISSUE 15 satellite)
 - ``[signal-docs]``  docs/telemetry.md's policy signal table vs the
   keys written into PolicyContext.metrics (KF605, ISSUE 16 satellite)
+- ``[endpoint-docs]`` docs/telemetry.md's endpoint table vs the HTTP
+  routes the worker server and cluster aggregator actually serve
+  (KF606, ISSUE 18 satellite)
 
 Exit status is the contract — 0 clean, 1 findings — matching the
 kfcheck CLI. ``tests/test_kfcheck.py`` invokes it as the tier-1 gate;
@@ -37,6 +40,7 @@ _DOC_RULES_METRICS = ("KF600", "KF601")
 _DOC_RULES_SPANS = ("KF602",)
 _DOC_RULES_AUDIT = ("KF604",)
 _DOC_RULES_SIGNALS = ("KF605",)
+_DOC_RULES_ENDPOINTS = ("KF606",)
 
 
 def _section(findings: List["core.Finding"], title: str, rules) -> List[str]:
@@ -61,7 +65,7 @@ def main(argv=None) -> int:
     doc_rules = (
         set(_DOC_RULES_KNOBS) | set(_DOC_RULES_METRICS)
         | set(_DOC_RULES_SPANS) | set(_DOC_RULES_AUDIT)
-        | set(_DOC_RULES_SIGNALS)
+        | set(_DOC_RULES_SIGNALS) | set(_DOC_RULES_ENDPOINTS)
     )
     code = [f for f in findings if f.rule not in doc_rules]
     out: List[str] = []
@@ -71,6 +75,7 @@ def main(argv=None) -> int:
     out.extend(_section(findings, "span-docs", _DOC_RULES_SPANS))
     out.extend(_section(findings, "audit-docs", _DOC_RULES_AUDIT))
     out.extend(_section(findings, "signal-docs", _DOC_RULES_SIGNALS))
+    out.extend(_section(findings, "endpoint-docs", _DOC_RULES_ENDPOINTS))
     n = len(findings)
     out.append(
         "check: clean" if n == 0

@@ -1279,6 +1279,116 @@ def check_signals_documented(project: Project) -> List[Finding]:
     return out
 
 
+# KF606 — endpoint doc lint (ISSUE 18 satellite): the KF602/604/605
+# shape for the HTTP surface itself. Every route literal served by the
+# worker telemetry server (telemetry/http.py's route dict) or the
+# cluster aggregator (telemetry/cluster.py's CLUSTER_ROUTES /
+# HOST_DIGEST_PATH) must appear in docs/telemetry.md's endpoint table,
+# and every table row must still be served. The endpoints are the
+# operator's front door; an undocumented route is invisible tooling and
+# a stale row is a 404 in the runbook. Routes assembled at runtime
+# (embedder extra_routes) are out of scope by construction — the scan
+# only reads these two files' literals.
+
+_ENDPOINT_FILES = frozenset({
+    "kungfu_tpu/telemetry/http.py",
+    "kungfu_tpu/telemetry/cluster.py",
+})
+_ENDPOINT_INDIRECT: frozenset = frozenset()
+_ENDPOINT_RE = re.compile(r"^/[a-z0-9_]+(?:/[a-z0-9_]+)*$")
+
+_ENDPOINT_TABLE_HEADING = "## Endpoint table"
+
+
+def _source_endpoints(project: Project) -> Set[str]:
+    """Every route-path string literal in the two files that define the
+    telemetry HTTP surface. Both files use the literals as dict/tuple
+    route keys, so any slash-leading path literal IS a route (or a
+    cursor key naming one — same string either way)."""
+    paths: Set[str] = set()
+    for ctx in project.files:
+        if ctx.relpath not in _ENDPOINT_FILES or ctx.tree is None:
+            continue
+        for node in ctx.walk():
+            if (
+                isinstance(node, ast.Constant)
+                and isinstance(node.value, str)
+                and _ENDPOINT_RE.match(node.value)
+            ):
+                paths.add(node.value)
+    return paths
+
+
+def _endpoint_table_rows(project: Project) -> Optional[List[Tuple[int, str]]]:
+    """(lineno, route path) per row of docs/telemetry.md's endpoint
+    table, or None when the doc/heading is missing."""
+    got = _telemetry_doc(project)
+    if got is None:
+        return None
+    rows: List[Tuple[int, str]] = []
+    in_table = False
+    for i, line in enumerate(got[1], start=1):
+        if line.strip() == _ENDPOINT_TABLE_HEADING:
+            in_table = True
+            continue
+        if in_table and line.startswith("## "):
+            break
+        if in_table and line.startswith("| `"):
+            for name in re.findall(r"`(/[a-z0-9_/]+)`", line.split("|")[1]):
+                rows.append((i, name))
+    return rows if in_table else None
+
+
+@rule(
+    "KF606",
+    "endpoint-doc-lint",
+    "every HTTP route literal served by the worker telemetry server "
+    "(telemetry/http.py) or the cluster aggregator (telemetry/"
+    "cluster.py) must appear in docs/telemetry.md's endpoint table AND "
+    "every table row must still be served — the endpoints are the "
+    "operator's front door, and an undocumented route (or stale row) "
+    "breaks exactly the curl the runbook prescribes (the KF602/604/605 "
+    "contract, for the HTTP surface)",
+    scope="project",
+)
+def check_endpoints_documented(project: Project) -> List[Finding]:
+    paths = _source_endpoints(project) | _ENDPOINT_INDIRECT
+    out: List[Finding] = []
+    if len(paths) <= 12:
+        # the scan must keep finding the route literals — moving the
+        # route tables must not silently turn this rule into a no-op
+        out.append(Finding(
+            "KF606", "docs/telemetry.md", 1,
+            f"endpoint scan found only {len(paths)} routes — the "
+            "literal scan looks broken (route dict moved?), fix the "
+            "rule before trusting it",
+        ))
+        return out
+    rows = _endpoint_table_rows(project)
+    if rows is None:
+        return [Finding(
+            "KF606", "docs/telemetry.md", 1,
+            f"docs/telemetry.md has no `{_ENDPOINT_TABLE_HEADING}` "
+            "section — add the endpoint table (one row per route)",
+        )]
+    documented = {name for _, name in rows}
+    for name in sorted(paths - documented):
+        out.append(Finding(
+            "KF606", "docs/telemetry.md", 1,
+            f"endpoint {name!r} is served by the package but absent "
+            "from docs/telemetry.md's endpoint table — add a row",
+        ))
+    for lineno, name in rows:
+        if name not in paths:
+            out.append(Finding(
+                "KF606", "docs/telemetry.md", lineno,
+                f"docs/telemetry.md's endpoint table documents {name!r} "
+                "but no code serves it — drop the stale row "
+                "(runtime-registered routes belong in _ENDPOINT_INDIRECT)",
+            ))
+    return out
+
+
 # ---------------------------------------------------------------------
 # KF7xx — distributed protocol (ISSUE 12: the first cross-module rules)
 # ---------------------------------------------------------------------

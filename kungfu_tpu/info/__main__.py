@@ -345,7 +345,45 @@ def render_top(health: dict) -> str:
             f"{peer}({','.join(buckets)})"
             for peer, buckets in sorted(leaks.items())
         )
-    return "\n".join([summary] + lines)
+    plane_line = _render_plane_line(health.get("plane"))
+    head = [summary] + ([plane_line] if plane_line else [])
+    return "\n".join(head + lines)
+
+
+def _render_plane_line(plane) -> str:
+    """Telemetry-plane health line (ISSUE 18): surfaces whether the
+    aggregator itself is keeping up — scrape mode (flat vs the scaled
+    hier/sampled shapes), last sweep wall time against its effective
+    interval (> interval means the plane is in backoff and the columns
+    above are staler than configured), and peers whose scrapes are
+    stale."""
+    if not isinstance(plane, dict) or not plane:
+        return ""
+    parts = [f"plane: {plane.get('mode', '?')}"]
+    sweep = plane.get("sweep_seconds")
+    interval = plane.get("effective_interval_s") or plane.get("interval_s")
+    if isinstance(sweep, (int, float)):
+        part = f"sweep {sweep:.2f}s"
+        if isinstance(interval, (int, float)) and interval > 0:
+            part += f"/{interval:g}s"
+            if sweep > interval:
+                part += " OVERLOADED"
+        parts.append(part)
+    scraped = plane.get("scraped_peers")
+    stale = plane.get("stale_peers")
+    if isinstance(scraped, int):
+        parts.append(f"{scraped} scraped")
+    # the envelope ships a count; older health docs may carry labels
+    if isinstance(stale, bool):
+        pass
+    elif isinstance(stale, int) and stale > 0:
+        parts.append(f"{stale} stale")
+    elif isinstance(stale, (list, tuple)) and stale:
+        parts.append(f"stale: {', '.join(stale)}")
+    age = plane.get("oldest_link_row_age_s")
+    if isinstance(age, (int, float)):
+        parts.append(f"oldest link row {age:.0f}s")
+    return ", ".join(parts)
 
 
 def _cmd_top(argv) -> int:

@@ -369,6 +369,43 @@ _knob("KF_CLUSTER_SCRAPE_INTERVAL", "5.0", _float,
       "Seconds between the aggregator's scrape sweeps over worker "
       "telemetry endpoints.",
       section=_SEC_CLUSTER, kind="float")
+_knob("KF_AGG_HIER_MIN_PEERS", "32", _int,
+      "At or above this many scrape targets the aggregator switches to "
+      "scale mode: hierarchical per-host fan-in (elected host heads "
+      "pre-merge their local workers into one /host/telemetry digest), "
+      "sampled link-matrix rotation and delta-cursor scrapes. Below it "
+      "the flat exact plane runs — small clusters keep today's "
+      "behavior bit-for-bit. 0 disables scale mode entirely.",
+      section=_SEC_CLUSTER, kind="int")
+_knob("KF_AGG_LINK_ROTATION_SWEEPS", "8", _int,
+      "In scale mode, the number of sweeps over which the link-matrix "
+      "row rotation covers every peer (each sweep ingests ~k/N rows). "
+      "Bounds every edge estimate's staleness at rotation_sweeps x "
+      "effective scrape interval.",
+      section=_SEC_CLUSTER, kind="int")
+_knob("KF_AGG_LINK_TOP_EDGES", "16", _int,
+      "In scale mode, the N slowest edges whose source rows are "
+      "re-ingested EVERY sweep regardless of rotation — the re-planner "
+      "input (min_bw / slowest_edge) can never be sampled out.",
+      section=_SEC_CLUSTER, kind="int")
+_knob("KF_AGG_LINK_MAX_AGE_S", "60.0", _float,
+      "ReplanPolicy refuses to vote for a re-plan while the oldest "
+      "sampled link-matrix row is older than this (the lockstep check "
+      "still runs; this peer votes no). 0 disables the staleness gate.",
+      section=_SEC_CLUSTER, kind="float")
+_knob("KF_AGG_DELTA", "",
+      _choice("KF_AGG_DELTA", ("", "auto", "on", "off"), empty_as="auto"),
+      "Delta scrapes: ship only new/changed records off the ring-backed "
+      "worker endpoints (?since= cursors on /steptrace, /decisions, "
+      "/audit). `auto` (default) enables them in scale mode only; "
+      "`on`/`off` force.",
+      section=_SEC_CLUSTER, kind="choice", default_doc="auto")
+_knob("KF_AGG_MAX_BACKOFF", "8.0", _float,
+      "Upper bound on the aggregator's overload backoff multiplier: "
+      "when a sweep overruns the scrape interval the effective interval "
+      "doubles (audited `aggregator_overload`) up to interval x this, "
+      "and cools back down when sweeps recover.",
+      section=_SEC_CLUSTER, kind="float")
 
 _SEC_LINK = "Link observability"
 _knob("KF_LINK_BW_MIN_BYTES", str(64 << 10), _int,

@@ -205,6 +205,10 @@ class Peer:
                     self._session.close(timeout=10.0)
             if peers.rank(self.self_id) is None:
                 self.detached = True
+                # a detached peer is not in the target set, so the
+                # election below would clear the role anyway — but it
+                # must happen even on this early exit
+                self._update_host_role(peers)
                 return False
             self.server.set_token(self.cluster_version)
             self.client.set_token(self.cluster_version)
@@ -253,6 +257,11 @@ class Peer:
                 tlink.get_table().prune(
                     list(peers) + list(self.config.runners)
                 )
+            # host sub-aggregator election (ISSUE 18): at scale the
+            # lowest-labelled worker per host pre-merges its siblings'
+            # telemetry for the root aggregator; membership changes
+            # re-elect deterministically on every peer
+            self._update_host_role(peers)
         if not self.config.single_process:
             # fail-fast BEFORE the barrier: the barrier itself walks
             # strategy-dependent graphs, so knob-divergent peers would
@@ -262,6 +271,21 @@ class Peer:
             self._session.barrier(tag=f":v{self.cluster_version}")
         self._updated = True
         return True
+
+    def _update_host_role(self, peers: PeerList) -> None:
+        """Recompute this worker's host sub-aggregator election (ISSUE
+        18). Never lets a telemetry-plane failure touch the resize
+        path: the role is an optimization the root falls back from."""
+        if self.config.single_process:
+            return
+        if getattr(self, "metrics_server", None) is None:
+            return  # no telemetry server, nothing to elect for
+        try:
+            from kungfu_tpu.telemetry import cluster as _cluster
+
+            _cluster.update_host_role(self.self_id, list(peers))
+        except Exception as e:  # noqa: BLE001 - telemetry must not break resizes
+            log.warn("host telemetry role update failed: %s", e)
 
     def set_tree(self, fathers) -> None:
         """Install a runtime collective tree on the CURRENT session epoch.

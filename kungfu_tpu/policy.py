@@ -59,6 +59,7 @@ from __future__ import annotations
 import time
 from typing import Callable, List, Optional
 
+from kungfu_tpu import knobs
 from kungfu_tpu.telemetry import log
 
 
@@ -326,7 +327,15 @@ class ReplanPolicy(BasePolicy):
     this policy never runs a collective. On adoption the session emits a
     ``topology_replanned`` audit event naming old→new order and the
     predicted gain; ``ctx.metrics['replan/last_order']`` mirrors it for
-    embedders."""
+    embedders.
+
+    Under the sampled link matrix (ISSUE 18) a row may be several
+    sweeps old; re-planning a ring off decayed measurements is worse
+    than keeping the current one. When the cluster plane publishes
+    ``links/oldest_row_age_s`` and it exceeds ``max_row_age_s``
+    (default ``KF_AGG_LINK_MAX_AGE_S``; 0 disables the gate) this peer
+    refuses to VOTE yes — the ``check_replan`` collective still runs
+    in lockstep so peers with fresh data stay in sync."""
 
     def __init__(
         self,
@@ -334,12 +343,19 @@ class ReplanPolicy(BasePolicy):
         patience: int = 3,
         min_gain: float = 1.05,
         session_supplier: Optional[Callable[[], object]] = None,
+        max_row_age_s: Optional[float] = None,
     ):
         if interval_steps < 1:
             raise ValueError("interval_steps must be >= 1")
         self.interval_steps = interval_steps
         self.patience = patience
         self.min_gain = min_gain
+        if max_row_age_s is None:
+            try:
+                max_row_age_s = float(knobs.get("KF_AGG_LINK_MAX_AGE_S"))
+            except (TypeError, ValueError):
+                max_row_age_s = 60.0
+        self.max_row_age_s = max_row_age_s
         self._session_supplier = session_supplier
         self._edge = None  # the persistently-named edge being watched
         self._streak = 0
@@ -385,6 +401,14 @@ class ReplanPolicy(BasePolicy):
         if sess is None or getattr(sess, "size", 1) < 2:
             return
         want = self._streak >= self.patience
+        if want and self.max_row_age_s > 0:
+            # sampled-matrix staleness gate (ISSUE 18): don't vote to
+            # re-plan off link rows older than the knob — the collective
+            # still runs so fresh peers stay in lockstep
+            age = ctx.metrics.get("links/oldest_row_age_s")
+            if isinstance(age, (int, float)) and age > self.max_row_age_s:
+                want = False
+                ctx.metrics["replan/vote_withheld_stale_links"] = age
         plan = sess.check_replan(want=want, min_gain=self.min_gain)
         if plan is not None:
             # adopted: restart the watch window against the new topology

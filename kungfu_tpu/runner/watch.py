@@ -66,52 +66,40 @@ class DebugServer:
     def __init__(self, watcher: "Watcher", port: int):
         from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 
+        from kungfu_tpu.telemetry.cluster import CLUSTER_ROUTES
+
+        # dispatch built from CLUSTER_ROUTES (ISSUE 18 satellite): the
+        # aggregator, this server and the endpoint-doc lint (KF606)
+        # share one route registry, so adding an aggregator view can't
+        # silently miss the server or the docs. /cluster/metrics is the
+        # text/plain exception; trace/audit serve compact JSON (multi-MB
+        # documents an indent would double).
+        renderers = {
+            "/cluster/metrics": lambda agg: (
+                agg.cluster_metrics(), "text/plain; version=0.0.4"
+            ),
+            "/cluster/trace": lambda agg: (
+                json.dumps(agg.cluster_trace()), "application/json"
+            ),
+            "/cluster/audit": lambda agg: (
+                json.dumps(agg.cluster_audit()), "application/json"
+            ),
+        }
+        for route in CLUSTER_ROUTES:
+            if route in renderers:
+                continue
+            method = "cluster_" + route.rsplit("/", 1)[1]
+            renderers[route] = lambda agg, m=method: (
+                json.dumps(getattr(agg, m)(), indent=2),
+                "application/json",
+            )
+
         def cluster_view(path: str):
             agg = getattr(watcher, "aggregator", None)
             if agg is None:
                 return None
-            if path == "/cluster/metrics":
-                return agg.cluster_metrics(), "text/plain; version=0.0.4"
-            if path == "/cluster/trace":
-                return json.dumps(agg.cluster_trace()), "application/json"
-            if path == "/cluster/health":
-                return (
-                    json.dumps(agg.cluster_health(), indent=2),
-                    "application/json",
-                )
-            if path == "/cluster/links":
-                return (
-                    json.dumps(agg.cluster_links(), indent=2),
-                    "application/json",
-                )
-            if path == "/cluster/steps":
-                return (
-                    json.dumps(agg.cluster_steps(), indent=2),
-                    "application/json",
-                )
-            if path == "/cluster/decisions":
-                return (
-                    json.dumps(agg.cluster_decisions(), indent=2),
-                    "application/json",
-                )
-            if path == "/cluster/resources":
-                return (
-                    json.dumps(agg.cluster_resources(), indent=2),
-                    "application/json",
-                )
-            if path == "/cluster/memory":
-                return (
-                    json.dumps(agg.cluster_memory(), indent=2),
-                    "application/json",
-                )
-            if path == "/cluster/audit":
-                return json.dumps(agg.cluster_audit()), "application/json"
-            if path == "/cluster/postmortem":
-                return (
-                    json.dumps(agg.cluster_postmortem(), indent=2),
-                    "application/json",
-                )
-            return None
+            render = renderers.get(path)
+            return None if render is None else render(agg)
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):

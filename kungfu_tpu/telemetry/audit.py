@@ -46,6 +46,12 @@ class AuditRecord:
     checkpoint_version: Optional[int] = None
     detached: bool = False
     detail: Optional[dict] = None
+    # delta-scrape cursor (ISSUE 18): `seq` is the record's stable
+    # identity in this process's log (dedupe key for aggregator-side
+    # caches); `useq` re-stamps on annotate_last so a `?since=` scrape
+    # re-ships records whose late-known fields changed
+    seq: Optional[int] = None
+    useq: Optional[int] = None
 
     def to_json(self) -> dict:
         return {
@@ -57,6 +63,16 @@ class AuditRecord:
 
 _lock = threading.Lock()
 _records: List[AuditRecord] = []
+_seq = 0  # identity space (stamped once per record)
+_useq = 0  # update-cursor space (re-stamped on annotate)
+
+
+def _stamp_locked(rec: AuditRecord) -> None:
+    global _seq, _useq
+    _seq += 1
+    _useq += 1
+    rec.seq = _seq
+    rec.useq = _useq
 
 
 def _metrics_hooks(rec: AuditRecord) -> None:
@@ -132,6 +148,7 @@ def record_resize(
         detached=detached,
     )
     with _lock:
+        _stamp_locked(rec)
         _records.append(rec)
         del _records[:-MAX_RECORDS]
     _metrics_hooks(rec)
@@ -148,6 +165,7 @@ def record_event(kind: str, *, peer: str = "", trigger: str = "", **detail) -> A
         detail={k: v for k, v in detail.items() if v is not None} or None,
     )
     with _lock:
+        _stamp_locked(rec)
         _records.append(rec)
         del _records[:-MAX_RECORDS]
     _metrics_hooks(rec)
@@ -171,18 +189,36 @@ def annotate_last(kind: str = "resize", peer: str = "", **fields) -> bool:
                 else:
                     rec.detail = dict(rec.detail or {})
                     rec.detail[k] = v
+            # the record changed: move it past every cursor that
+            # already shipped it, keeping its stable identity (seq)
+            global _useq
+            _useq += 1
+            rec.useq = _useq
             return True
     return False
 
 
-def records(kind: Optional[str] = None, peer: str = "") -> List[AuditRecord]:
+def records(
+    kind: Optional[str] = None, peer: str = "",
+    since: Optional[int] = None,
+) -> List[AuditRecord]:
     with _lock:
         out = list(_records)
     if kind:
         out = [r for r in out if r.kind == kind]
     if peer:
         out = [r for r in out if r.peer == str(peer)]
+    if since is not None:
+        out = [r for r in out if (r.useq or 0) > since]
     return out
+
+
+def next_since() -> int:
+    """The current delta-scrape cursor: passing this as ``since`` to a
+    later :func:`records`/:func:`to_json` ships only records created or
+    annotated after this call."""
+    with _lock:
+        return _useq
 
 
 def clear() -> None:
@@ -190,8 +226,8 @@ def clear() -> None:
         _records.clear()
 
 
-def to_json() -> List[dict]:
-    return [r.to_json() for r in records()]
+def to_json(since: Optional[int] = None) -> List[dict]:
+    return [r.to_json() for r in records(since=since)]
 
 
 def to_jsonl() -> str:

@@ -100,6 +100,10 @@ class DecisionRecord:
         "predicted_gain", "detail", "wall_time", "t_us",
         "status", "baseline", "after", "realized_gain", "verdict",
         "regressed", "closed_wall_time", "t_closed_us",
+        # delta-scrape cursor (ISSUE 18): bumped on every visible
+        # mutation (open, close, watchdog updates) so `?since=` ships a
+        # record again whenever its merged copy needs updating
+        "useq",
         # measurement state (never serialized)
         "_settle_left", "_samples", "_watch_below",
     )
@@ -128,6 +132,7 @@ class DecisionRecord:
         self.regressed = False
         self.closed_wall_time: Optional[float] = None
         self.t_closed_us: Optional[float] = None
+        self.useq = 0
         self._settle_left = settle
         self._samples: List[float] = []
         self._watch_below = 0
@@ -143,6 +148,7 @@ class DecisionRecord:
             "t_us": self.t_us,
             "status": self.status,
             "predicted_gain": self.predicted_gain,
+            "useq": self.useq,
         }
         # copies, not references: the watchdog mutates detail (and the
         # measurement fields) under the ledger lock while HTTP scrapes /
@@ -202,6 +208,10 @@ class DecisionLedger:
         self._recent: "deque[float]" = deque(maxlen=self.window)
         self._open: List[DecisionRecord] = []
         self._seq = 0
+        # delta-scrape cursor space (ISSUE 18): a record's useq is
+        # re-stamped on every visible mutation, so `export(since=N)`
+        # ships exactly the records whose merged copies are out of date
+        self._useq = 0
         self._lock = threading.Lock()
         self._g_gain = self._c_total = None
         if tconfig.metrics_enabled():
@@ -275,6 +285,8 @@ class DecisionLedger:
                 detail=detail or None, baseline=base, settle=self.settle,
             )
             self._seq += 1
+            self._useq += 1
+            rec.useq = self._useq
             self._ring.append(rec)
             if base is not None:
                 self._open.append(rec)
@@ -305,6 +317,11 @@ class DecisionLedger:
                     continue
                 win = _Window(rec._samples)
                 rec._samples = []
+                # every branch below mutates the record (close, watchdog
+                # gain update, regress, recovery note) — re-stamp its
+                # delta cursor so `?since=` re-ships the merged update
+                self._useq += 1
+                rec.useq = self._useq
                 if rec.status == "open":
                     self._close_locked(rec, win)
                     closed.append(rec)
@@ -435,11 +452,19 @@ class DecisionLedger:
             recs = [r.to_json() for r in list(self._ring)[-max(0, n):]]
         return recs
 
-    def export(self, peer: str = "") -> dict:
+    def export(self, peer: str = "", since: Optional[int] = None) -> dict:
         """The /decisions document: the ring plus the clock anchors the
-        aggregator aligns on (the /steptrace contract)."""
+        aggregator aligns on (the /steptrace contract). ``since`` is
+        the delta-scrape cursor (ISSUE 18): only records whose useq
+        moved past it ship — new records AND records that mutated
+        (closed, regressed) since the last scrape, so the aggregator's
+        update-in-place merge stays correct on deltas."""
         with self._lock:
-            recs = [r.to_json() for r in self._ring]
+            recs = [
+                r.to_json() for r in self._ring
+                if since is None or r.useq > since
+            ]
+            next_since = self._useq
         return {
             "peer": peer or knobs.raw("KF_SELF_SPEC"),
             "perf_now_us": _now_us(),
@@ -448,6 +473,7 @@ class DecisionLedger:
             "window": self.window,
             "settle": self.settle,
             "regress_ratio": self.regress_ratio,
+            "next_since": next_since,
             "decisions": recs,
         }
 

@@ -18,7 +18,17 @@ serve the whole telemetry subsystem):
 - ``/resources`` the resource attribution plane's per-bucket CPU
   accounting + optional profiler aggregation (ISSUE 16), same anchors;
 - ``/memory`` the memory attribution plane's per-bucket byte
-  accounting + headroom forecast (ISSUE 17), same anchors.
+  accounting + headroom forecast (ISSUE 17), same anchors;
+- ``/host/telemetry`` the per-host sub-aggregator digest (ISSUE 18):
+  a worker elected host head pre-merges its local siblings' endpoints
+  into one document so the root aggregator sweeps O(hosts), not O(k);
+  non-elected workers answer ``{"enabled": false}``.
+
+Ring-backed endpoints (``/steptrace``, ``/decisions``, ``/audit``)
+take a ``?since=<seq>`` delta cursor (ISSUE 18): re-scrapes ship only
+records created or mutated past the cursor, with the next cursor in
+the document (``next_since``; the audit list carries per-record
+``useq`` instead).
 
 Shutdown is clean: ``stop()`` both shuts the serve loop down AND closes
 the listening socket, so a stopped peer never leaks its telemetry port
@@ -27,6 +37,7 @@ the listening socket, so a stopped peer never leaks its telemetry port
 
 from __future__ import annotations
 
+import inspect
 import json
 import threading
 import time
@@ -43,19 +54,32 @@ CLOCK_HEADER = "X-KF-Perf-Now-Us"
 WALL_HEADER = "X-KF-Wall-Time-S"
 
 
-def _steptrace_doc() -> dict:
+def _since(query: Dict[str, str]) -> Optional[int]:
+    """Parse the delta-scrape cursor (ISSUE 18) off a route's query
+    dict; a malformed value reads as 'no cursor' (full document) — a
+    scraper must never get a 500 for a bad cursor."""
+    raw = query.get("since")
+    if raw is None:
+        return None
+    try:
+        return int(raw)
+    except ValueError:
+        return None
+
+
+def _steptrace_doc(since: Optional[int] = None) -> dict:
     # lazy: most processes serving /metrics never record a step, and the
     # store's knobs should resolve at first USE, not server construction
     from kungfu_tpu.telemetry import steptrace
 
-    return steptrace.get_store().export()
+    return steptrace.get_store().export(since=since)
 
 
-def _decisions_doc() -> dict:
+def _decisions_doc(since: Optional[int] = None) -> dict:
     # lazy for the same reason: the ledger's knobs resolve at first use
     from kungfu_tpu.telemetry import decisions
 
-    return decisions.get_ledger().export()
+    return decisions.get_ledger().export(since=since)
 
 
 def _resources_doc() -> dict:
@@ -70,6 +94,37 @@ def _memory_doc() -> dict:
     from kungfu_tpu.telemetry import memory
 
     return memory.get_plane().export()
+
+
+def _host_doc() -> dict:
+    # lazy: only a worker elected host sub-aggregator (ISSUE 18) serves
+    # a real digest; everyone else answers {"enabled": false} so the
+    # root can probe the role cheaply
+    from kungfu_tpu.telemetry import cluster
+
+    return cluster.host_digest_doc()
+
+
+def _adapt_route(fn: Callable) -> Callable[[Dict[str, str]], "tuple[str, str]"]:
+    """Make a route callable accept the parsed query dict. Routes that
+    already take one positional parameter get it; zero-arg callables
+    (the historical extra_routes contract) are wrapped — back-compat
+    for embedders registering plain thunks."""
+    try:
+        params = [
+            p for p in inspect.signature(fn).parameters.values()
+            if p.default is inspect.Parameter.empty
+            and p.kind in (
+                inspect.Parameter.POSITIONAL_ONLY,
+                inspect.Parameter.POSITIONAL_OR_KEYWORD,
+            )
+        ]
+        takes_query = len(params) >= 1
+    except (TypeError, ValueError):
+        takes_query = False
+    if takes_query:
+        return fn
+    return lambda query, _fn=fn: _fn()
 
 
 class TelemetryServer:
@@ -89,22 +144,24 @@ class TelemetryServer:
             metrics.update_process_health(reg)
             return reg.render(), "text/plain; version=0.0.4"
 
+        # ring-backed endpoints take the ?since=<seq> delta cursor
+        # (ISSUE 18); the rest ignore their query dict
         routes: Dict[str, Callable[[], "tuple[str, str]"]] = {
             "/metrics": _metrics_page,
             "/trace": lambda: (
                 tracing.chrome_trace_json(),
                 "application/json",
             ),
-            "/audit": lambda: (
-                json.dumps(audit.to_json()),
+            "/audit": lambda q: (
+                json.dumps(audit.to_json(since=_since(q))),
                 "application/json",
             ),
-            "/steptrace": lambda: (
-                json.dumps(_steptrace_doc()),
+            "/steptrace": lambda q: (
+                json.dumps(_steptrace_doc(_since(q))),
                 "application/json",
             ),
-            "/decisions": lambda: (
-                json.dumps(_decisions_doc()),
+            "/decisions": lambda q: (
+                json.dumps(_decisions_doc(_since(q))),
                 "application/json",
             ),
             "/resources": lambda: (
@@ -115,27 +172,34 @@ class TelemetryServer:
                 json.dumps(_memory_doc()),
                 "application/json",
             ),
+            "/host/telemetry": lambda: (
+                json.dumps(_host_doc()),
+                "application/json",
+            ),
         }
         if extra_routes:
             routes.update(extra_routes)
+        routes = {path: _adapt_route(fn) for path, fn in routes.items()}
 
         class Handler(BaseHTTPRequestHandler):
             def log_message(self, *a):  # quiet
                 pass
 
             def do_GET(inner):
-                from urllib.parse import urlsplit
+                from urllib.parse import parse_qsl, urlsplit
 
                 # query/fragment never select the route: a scraper's
                 # cache-buster (/metrics?t=...) must hit /metrics
-                path = urlsplit(inner.path).path.rstrip("/")
+                split = urlsplit(inner.path)
+                path = split.path.rstrip("/")
                 route = routes.get(path or "/metrics")
                 if route is None:
                     inner.send_response(404)
                     inner.end_headers()
                     return
                 try:
-                    body_s, ctype = route()
+                    query = dict(parse_qsl(split.query))
+                    body_s, ctype = route(query)
                 except Exception as e:  # noqa: BLE001 - a broken view is a 500, not a crash
                     inner.send_response(500)
                     inner.end_headers()

@@ -241,7 +241,7 @@ class StepRecorder:
 
     __slots__ = (
         "epoch", "round", "t_begin_us", "t_end_us",
-        "flush_wait_us", "busy_us", "buckets", "_lock",
+        "flush_wait_us", "busy_us", "buckets", "_lock", "flush_seq",
     )
 
     def __init__(self, epoch: int, round_: int):
@@ -250,6 +250,11 @@ class StepRecorder:
         self.round = int(round_)
         self.t_begin_us = _now_us()
         self.t_end_us: Optional[float] = None
+        # delta-scrape cursor (ISSUE 18): assigned by the store at the
+        # first export AFTER the timeline flushed — transport metadata,
+        # deliberately kept out of to_json so merged lanes are identical
+        # whether the scraper used a cursor or not
+        self.flush_seq: Optional[int] = None
         self.flush_wait_us = 0.0
         self.busy_us = 0.0
         self.buckets: Dict[int, BucketLane] = {}
@@ -317,6 +322,11 @@ class StepStore:
         self._lock = threading.Lock()
         self._sampler = _Sampler()
         self._stats = {"recorded": 0, "sampled_out": 0}
+        # delta-scrape cursor space (ISSUE 18): monotonically increasing
+        # across the store's lifetime (clear() keeps it), stamped onto
+        # timelines at the first export after they flush — `?since=N`
+        # re-scrapes ship only newly-flushed timelines
+        self._seq = 0
         # memory plane (ISSUE 17): the ring is a long-lived buffer
         # owner; it reports its CAP (mean item x maxlen) so filling up
         # never looks like a leak. Weakref — reset_store() must not
@@ -374,17 +384,44 @@ class StepStore:
             self._ring.clear()
             self._stats = {"recorded": 0, "sampled_out": 0}
 
-    def export(self, peer: str = "") -> dict:
+    def export(self, peer: str = "", since: Optional[int] = None) -> dict:
         """The /steptrace document: the ring plus the clock anchors the
         aggregator needs (perf_now_us matches the X-KF-Perf-Now-Us
-        header timebase)."""
+        header timebase).
+
+        ``since`` is the delta-scrape cursor (ISSUE 18): each timeline
+        is stamped with a monotonic flush seq at its first post-flush
+        export, carried transport-side as ``seq`` (NOT in the merged
+        lanes); ``since=N`` ships only flushed timelines with seq > N,
+        and ``next_since`` is the cursor for the next scrape. A
+        timeline that falls off the ring before it is ever shipped is
+        lost — the same bounded-ring contract the full export has."""
+        with self._lock:
+            recs = list(self._ring)
+            for r in recs:
+                if r.t_end_us is not None and r.flush_seq is None:
+                    self._seq += 1
+                    r.flush_seq = self._seq
+            next_since = self._seq
+        timelines = []
+        for r in recs:
+            if since is not None and (
+                r.t_end_us is None
+                or (r.flush_seq or 0) <= since
+            ):
+                continue
+            d = r.to_json()
+            if r.flush_seq is not None:
+                d["seq"] = r.flush_seq
+            timelines.append(d)
         return {
             "peer": peer or knobs.raw("KF_SELF_SPEC"),
             "perf_now_us": _now_us(),
             "wall_time_s": time.time(),
             "keep": self._keep,
+            "next_since": next_since,
             "stats": self.stats(),
-            "timelines": self.timelines(),
+            "timelines": timelines,
         }
 
     def local_signals(self) -> Dict[str, float]:
@@ -490,6 +527,10 @@ def align_timeline(tl: dict, offset_us: float) -> dict:
     shifted by `offset_us` onto the merger's timeline (the aggregator's
     NTP-style clock offset: runner_time = worker_time + offset)."""
     out = dict(tl)
+    # the delta-scrape cursor (ISSUE 18) is transport metadata between
+    # one store and one scraper — merged lanes must be identical whether
+    # the scraper used a cursor or not
+    out.pop("seq", None)
     for key in ("t_begin_us", "t_end_us"):
         if isinstance(out.get(key), (int, float)):
             out[key] = out[key] + offset_us

@@ -1,8 +1,54 @@
 """python -m kungfu_tpu.info (parity: python -m kungfu.info)."""
 
+import json
 import os
 import subprocess
 import sys
+
+
+def test_cluster_json_views_share_plane_envelope():
+    """Every JSON document the info CLI renders with --json (top,
+    links, steps, decisions, resources, memory) carries the SAME
+    telemetry-plane envelope under `plane` (ISSUE 18), so an operator
+    can judge monitoring freshness from whichever view is open."""
+    from kungfu_tpu.telemetry import cluster as tcluster
+    from kungfu_tpu.telemetry import metrics
+
+    def fetch(base_url, path, timeout):
+        if path.startswith("/metrics"):
+            return b"kungfu_steps_total 3\n", {}
+        doc = {"peer": base_url, "wall_time_s": 0.0}
+        return json.dumps(doc).encode(), {}
+
+    agg = tcluster.TelemetryAggregator(
+        interval=5.0, registry=metrics.Registry(), fetch=fetch
+    )
+    agg.set_peers([("w0", "http://h:9000"), ("w1", "http://h:9001")])
+    try:
+        health = agg.scrape_once()
+        docs = {
+            "top": health,
+            "links": agg.cluster_links(),
+            "steps": agg.cluster_steps(),
+            "decisions": agg.cluster_decisions(),
+            "resources": agg.cluster_resources(),
+            "memory": agg.cluster_memory(),
+        }
+        envelopes = {name: doc.get("plane") for name, doc in docs.items()}
+        for name, env in envelopes.items():
+            assert isinstance(env, dict), f"{name} missing plane envelope"
+            assert env["mode"] == "flat"
+            for key in ("interval_s", "effective_interval_s",
+                        "sweep_seconds", "scraped_peers", "stale_peers"):
+                assert key in env, f"{name} plane missing {key}"
+        # one envelope, shared shape: every view agrees on the mode and
+        # cadence fields (sweep_age_s may differ between render times)
+        first = envelopes["top"]
+        for env in envelopes.values():
+            assert env["mode"] == first["mode"]
+            assert env["interval_s"] == first["interval_s"]
+    finally:
+        agg.stop()
 
 
 def test_info_runs():
