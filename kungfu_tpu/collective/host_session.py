@@ -173,6 +173,13 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         # plan decides every segmented walk's bounds.
         self.replan_mode = knobs.get("KF_CONFIG_REPLAN")
         self._ring_plan: Optional[rp.RingPlan] = None
+        # two-level plan state (ISSUE 19): the adopted HierPlan (None =
+        # flat), the cluster-agreed demoted set it carries, and the
+        # demotion patience every peer must share (it gates the lockstep
+        # demote rounds, so it rides the knob consensus)
+        self._hier_plan: Optional[rp.HierPlan] = None
+        self._demoted: Tuple[int, ...] = ()
+        self.demote_patience = int(knobs.get("KF_REPLAN_DEMOTE_PATIENCE"))
         self._replan_seq = 0
         self._replan_listeners: List[object] = []
         # ZeRO-1 sharded-update knob (ISSUE 11): resolved once per epoch
@@ -286,8 +293,21 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
                 "Measured-topology re-plans adopted by this peer's "
                 "session epochs",
             )
+            # two-level plan role (ISSUE 19): (level, role) of this peer
+            # in the active hierarchy — level `flat` (no hierarchy) or
+            # `intra`/`inter` (member vs elected head of the inter-host
+            # ring), role `member`/`head`/`demoted`; the VALUE is the
+            # peer's host-group index, so the aggregator can reconstruct
+            # the full hierarchy like it does the flat ring
+            self._ring_role_g = tmetrics.gauge(
+                "kungfu_topology_ring_role",
+                "Active two-level plan role of this peer (child per "
+                "(level, role), value = host-group index)",
+                ("level", "role"),
+            )
         else:
             self._ring_pos_g = self._ring_next_g = self._replans_ctr = None
+            self._ring_role_g = None
         self._publish_ring_metrics()
         # collective-order sentinel (ISSUE 12): with the debug knob set,
         # protowatch wraps this instance's public entry points at bind
@@ -606,8 +626,30 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
 
     def ring_plan(self) -> Optional[rp.RingPlan]:
         """The adopted measured-topology plan, or None for the naive
-        rank-order ring with equal segments."""
+        rank-order ring with equal segments. Under a two-level plan
+        this is its FLAT projection (``HierPlan.as_ring_plan``) — the
+        single layout every flat consumer (ZeRO shard bounds, ring
+        gauges, the segmented RS/AG legs) keeps reading unchanged."""
         return self._ring_plan
+
+    def hier_plan(self) -> Optional[rp.HierPlan]:
+        """The adopted two-level plan (ISSUE 19), or None when the
+        session runs a flat ring."""
+        return self._hier_plan
+
+    def demoted_peers(self) -> Tuple[int, ...]:
+        """Ranks currently voted into the demoted (backup) role."""
+        return self._demoted
+
+    def _static_hosts(self) -> List[List[int]]:
+        """The static host partition as rank groups — the clustering
+        fallback when the measured matrix is not bimodal enough to
+        derive host boundaries."""
+        _, master_of = self.peers.partition_by_host()
+        groups: Dict[int, List[int]] = {}
+        for r in range(self.size):
+            groups.setdefault(master_of[r], []).append(r)
+        return [sorted(g) for _, g in sorted(groups.items())]
 
     def owned_bounds(self, count: int) -> Tuple[int, int]:
         """(begin, end) bounds of the segment THIS rank owns fully
@@ -735,10 +777,36 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         # is clamped by the busiest peer's CPU fraction — gathered like
         # the matrix so every peer clamps by the identical scalar
         compute_frac = self.measured_compute_frac()
-        plan = rp.derive_plan(
-            matrix, mode=self.replan_mode, current=self._ring_plan,
-            compute_frac=compute_frac,
-        )
+        if self.replan_mode == "hier":
+            # two-level mode (ISSUE 19): derive the hierarchy from the
+            # shared matrix; on a single host group (nothing to nest)
+            # fall back to the flat measured ring — same pure-function
+            # contract, every peer takes the same branch
+            hier = rp.derive_hier_plan(
+                matrix, hosts=self._static_hosts(), mode=self.replan_mode,
+                current=self._hier_plan, compute_frac=compute_frac,
+                demoted=self._demoted,
+            )
+            if hier is not None:
+                if not self._hier_worthwhile(hier, min_gain):
+                    self._replan_seq += 1
+                    return None
+                self.adopt_replan(hier)
+                return self._ring_plan
+            if self._hier_plan is not None:
+                # current hierarchy still the best derivation: keep it
+                # (a flat fallback here would silently tear it down)
+                self._replan_seq += 1
+                return None
+            plan = rp.derive_plan(
+                matrix, mode="auto", current=self._ring_plan,
+                compute_frac=compute_frac,
+            )
+        else:
+            plan = rp.derive_plan(
+                matrix, mode=self.replan_mode, current=self._ring_plan,
+                compute_frac=compute_frac,
+            )
         if plan is None or not self._replan_worthwhile(plan, min_gain):
             # nothing derivable, or the predicted win doesn't clear the
             # bar — seq still advances (every peer took the same branch:
@@ -747,6 +815,82 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             return None
         self.adopt_replan(plan)
         return plan
+
+    def _hier_worthwhile(self, plan: rp.HierPlan, min_gain: float) -> bool:
+        """Churn gate for two-level derivations, pure like
+        `_replan_worthwhile`: the FIRST hierarchy (or any change to the
+        demoted set) is structural and always adopted — demotions are
+        voted deliberately and their win is graded by the ledger, not
+        predicted — while a re-derivation that merely reshuffles groups
+        or heads must clear ``min_gain``."""
+        cur = self._hier_plan
+        if cur is None or plan.demoted != cur.demoted:
+            return True
+        return plan.gain >= min_gain
+
+    def check_demote(
+        self,
+        demote: Optional[int] = None,
+        promote: Optional[int] = None,
+        tag: str = "",
+    ) -> Optional[rp.RingPlan]:
+        """One lockstep demote/promote round (ISSUE 19): call on EVERY
+        peer at the same step boundary, like :meth:`check_replan`. Each
+        peer proposes at most one rank to demote into the backup role
+        and one to promote back; a one-hot per-candidate SUM on the
+        knob-independent star walk counts the proposals, candidates
+        carried by a strict majority flip, and the changed demoted set
+        re-derives the two-level plan from freshly exchanged matrix
+        rows, adopted through the ordinary :meth:`adopt_replan` digest +
+        listener bracket (the ledger opens a `peer_demoted` /
+        `peer_promoted` record per flipped rank there).
+
+        Returns the adopted flat projection, or None when no candidate
+        carried, the set didn't change, or no hierarchy is derivable
+        (demotion only acts under an active two-level mode — a flat
+        ring routes around stragglers instead). A vote that would
+        demote the last contributing member of a host is rejected by
+        the derivation (no head candidate), never half-applied."""
+        if (
+            self.replan_mode != "hier"
+            or self.size < 2
+            or self._tree_override
+        ):
+            return None
+        k = self.size
+        ballot = np.zeros(2 * k, np.int32)
+        if demote is not None and 0 <= int(demote) < k:
+            ballot[int(demote)] = 1
+        if promote is not None and 0 <= int(promote) < k:
+            ballot[k + int(promote)] = 1
+        counts = np.zeros(2 * k, np.int32)
+        self._fixed_allreduce(Workspace(
+            ballot, counts, ReduceOp.SUM,
+            self._replan_name("demote") + tag,
+        ))
+        demotes = {r for r in range(k) if int(counts[r]) * 2 > k}
+        promotes = {r for r in range(k) if int(counts[k + r]) * 2 > k}
+        new_demoted = tuple(sorted(
+            (set(self._demoted) | demotes) - promotes
+        ))
+        if new_demoted == self._demoted:
+            self._replan_seq += 1
+            return None
+        matrix = self.measured_matrix()
+        compute_frac = self.measured_compute_frac()
+        hier = rp.derive_hier_plan(
+            matrix, hosts=self._static_hosts(), mode=self.replan_mode,
+            current=self._hier_plan, compute_frac=compute_frac,
+            demoted=new_demoted,
+        )
+        if hier is None:
+            # not derivable with the new set (single host group, or a
+            # host would lose its last head) — same branch on every
+            # peer: the inputs are all shared
+            self._replan_seq += 1
+            return None
+        self.adopt_replan(hier)
+        return self._ring_plan
 
     def _replan_worthwhile(self, plan: rp.RingPlan, min_gain: float) -> bool:
         """Churn gate, a pure function of (current plan, derived plan):
@@ -764,10 +908,11 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             for n, o in zip(plan.weights, cur.weights)
         )
 
-    def adopt_replan(self, plan: Optional[rp.RingPlan]) -> None:
-        """Install ``plan`` (None = back to the naive ring) as the
-        active topology, cluster-safely; call in lockstep on every peer
-        at a step boundary (no walk in flight).
+    def adopt_replan(self, plan) -> None:
+        """Install ``plan`` (a :class:`RingPlan`, a :class:`HierPlan`,
+        or None = back to the naive ring) as the active topology,
+        cluster-safely; call in lockstep on every peer at a step
+        boundary (no walk in flight).
 
         The plan digest is asserted on the knob-INDEPENDENT star walk
         first (KF700/701 discipline): a peer whose matrix-fed derivation
@@ -775,7 +920,12 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         hang inside a later walk whose segment bounds silently differ.
         Registered listeners bracket the swap (``pre_replan`` runs under
         the OLD plan — the ZeRO-1 session exports exact state there —
-        and ``post_replan`` re-shards under the new)."""
+        and ``post_replan`` re-shards under the new). A HierPlan
+        installs BOTH itself (driving the two-level walk) and its flat
+        projection (``as_ring_plan``), so every flat consumer —
+        owned_bounds, the ring gauges, the ZeRO RS/AG legs — re-shards
+        through the same one listener bracket, flat→hier flips
+        included."""
         seq = self._replan_seq
         self._replan_seq += 1
         if not self._bytes_agree(
@@ -793,12 +943,21 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
                 "mismatched segment bounds (walks would deadlock or "
                 "corrupt); this is a determinism bug, not a transient"
             )
+        if isinstance(plan, rp.HierPlan):
+            hier: Optional[rp.HierPlan] = plan
+            flat: Optional[rp.RingPlan] = plan.as_ring_plan()
+        else:
+            hier = None
+            flat = plan
         tokens = [
             (listener, listener.pre_replan())
             for listener in self._replan_listeners
         ]
         old = self._ring_plan
-        self._ring_plan = plan
+        old_demoted = self._demoted
+        self._ring_plan = flat
+        self._hier_plan = hier
+        self._demoted = hier.demoted if hier is not None else ()
         for listener, token in tokens:
             listener.post_replan(token)
         self._publish_ring_metrics()
@@ -812,14 +971,18 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             trigger="replan_vote",
             old_order=list(old.order) if old is not None else list(range(self.size)),
             new_order=(
-                list(plan.order) if plan is not None
+                list(flat.order) if flat is not None
                 else list(range(self.size))
             ),
-            weighted=bool(plan is not None and plan.weights is not None),
-            predicted_gain=plan.gain if plan is not None else 1.0,
+            weighted=bool(flat is not None and flat.weights is not None),
+            hier=hier is not None,
+            demoted=list(self._demoted),
+            predicted_gain=flat.gain if flat is not None else 1.0,
         )
         # decision ledger (ISSUE 15): the re-plan predicted a throughput
-        # ratio — this record is what finally measures the realized one
+        # ratio — this record is what finally measures the realized one.
+        # Demote/promote flips get their OWN named records (ISSUE 19) so
+        # `info decisions` can grade each straggler demotion separately.
         from kungfu_tpu.telemetry import decisions as _decisions
 
         _decisions.open_decision(
@@ -827,17 +990,36 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             peer=str(self.self_id),
             epoch=self.cluster_version,
             trigger="replan_vote",
-            predicted_gain=plan.gain if plan is not None else 1.0,
+            predicted_gain=flat.gain if flat is not None else 1.0,
             old_order=",".join(
                 str(r) for r in (old.order if old is not None
                                  else range(self.size))
             ),
             new_order=",".join(
-                str(r) for r in (plan.order if plan is not None
+                str(r) for r in (flat.order if flat is not None
                                  else range(self.size))
             ),
-            weighted=bool(plan is not None and plan.weights is not None),
+            weighted=bool(flat is not None and flat.weights is not None),
+            hier=hier is not None,
         )
+        for r in sorted(set(self._demoted) - set(old_demoted)):
+            _decisions.open_decision(
+                "peer_demoted",
+                peer=str(self.self_id),
+                epoch=self.cluster_version,
+                trigger="straggler_patience",
+                predicted_gain=flat.gain if flat is not None else 1.0,
+                demoted_rank=str(r),
+            )
+        for r in sorted(set(old_demoted) - set(self._demoted)):
+            _decisions.open_decision(
+                "peer_promoted",
+                peer=str(self.self_id),
+                epoch=self.cluster_version,
+                trigger="straggler_recovered",
+                predicted_gain=1.0,
+                promoted_rank=str(r),
+            )
 
     def _publish_ring_metrics(self) -> None:
         """Refresh the active-ring gauges (position + successor edge)
@@ -855,6 +1037,20 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         self._ring_next_g.clear_children()
         if succ is not None:
             self._ring_next_g.labels(str(succ)).set(1)
+        if self._ring_role_g is not None:
+            self._ring_role_g.clear_children()
+            hier = self._hier_plan
+            if hier is None:
+                self._ring_role_g.labels("flat", "member").set(0)
+            else:
+                gi = hier.group_of(self.rank)
+                if self.rank in hier.demoted:
+                    level, role = "intra", "demoted"
+                elif self.rank == hier.heads[gi]:
+                    level, role = "inter", "head"
+                else:
+                    level, role = "intra", "member"
+                self._ring_role_g.labels(level, role).set(gi)
 
     def cross_all_reduce(self, w: Workspace) -> None:
         """AllReduce across host masters only (hierarchical path). While
@@ -1006,6 +1202,7 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             ("KF_CONFIG_ASYNC", self.async_mode),
             ("KF_CONFIG_ZERO", self.zero_mode),
             ("KF_CONFIG_REPLAN", self.replan_mode),
+            ("KF_REPLAN_DEMOTE_PATIENCE", str(self.demote_patience)),
         ]
 
     def _fixed_allreduce(self, w: Workspace) -> None:

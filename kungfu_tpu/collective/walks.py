@@ -117,6 +117,16 @@ class WalkEngine:
     # is a constant and the env override must be set fleet-wide.
     SEGMENT_MIN_BYTES = int(knobs.get("KF_CONFIG_SEGMENT_MIN_BYTES"))
 
+    # adopted two-level plan (ISSUE 19): set in lockstep by
+    # adopt_replan, None = flat ring. Class default so the mixin is
+    # safe before the facade constructor runs.
+    _hier_plan = None
+    # intra-leg wire-label override (see _run_hier): the two-level
+    # walk's intra star legs run through _run_graphs by design, not as
+    # a fallback — they must neither fire the segmented_fallback audit
+    # nor pollute the RING_SEGMENTED/BINARY_TREE series.
+    _wire_label_override: Optional[str] = None
+
     def _segmented_active(self) -> bool:
         return (
             not self._tree_override
@@ -143,6 +153,9 @@ class WalkEngine:
         other path returns None and w.recv holds the result."""
         wire = self._wire_codec_for(w)
         if self._segmented_active() and w.recv.nbytes >= self.SEGMENT_MIN_BYTES:
+            if self._hier_plan is not None:
+                self._run_hier(w, cancel=cancel, wire=wire)
+                return None
             return self._run_segmented(
                 w, cancel=cancel, wire=wire, defer_decode=defer_decode
             )
@@ -185,6 +198,8 @@ class WalkEngine:
         the same attribution with the walk's dominant edge — the ring's
         successor when the walk names one, else the slowest estimated
         link — so the step timeline can name the blocking edge."""
+        # (shared by the flat segmented walk, the graph walks and the
+        # two-level walk's inter leg)
         link_dst = link_bw = None
         if self._links is not None:
             link_dst, link_bw = self._links.min_bandwidth(dsts)
@@ -209,6 +224,8 @@ class WalkEngine:
         epoch is audited (`segmented_fallback`) so the by-design
         tree-under-segmented path is visible, not silent (ISSUE 14
         satellite; PR 4's counter-purity rule)."""
+        if self._wire_label_override is not None:
+            return self._wire_label_override
         if self._tree_override:
             return "SET_TREE"
         active = self._candidates[self.adaptive.active][0]
@@ -642,6 +659,86 @@ class WalkEngine:
             wall, prof, dsts=[send_peer], sink=steptrace_sink,
         )
         return deferred
+
+    # ------------------------------------------------------------------
+    # two-level (hierarchical) walk — ISSUE 19
+    # ------------------------------------------------------------------
+
+    def _run_hier(
+        self,
+        w: Workspace,
+        cancel: Optional[threading.Event] = None,
+        wire: Optional[DType] = None,
+    ) -> None:
+        """Two-level allreduce over the adopted :class:`HierPlan`
+        (arXiv:1909.09756's 2D shape): (1) intra-host star reduce of
+        every contributing member onto its host head — the fast
+        shm/loopback links, always exact f32; (2) segmented ring
+        allreduce over the heads only (`_run_segmented`'s subset
+        variant) — the DCN leg, wire-codec-eligible; (3) intra-host
+        star broadcast of the result back to every member, demoted
+        peers included.
+
+        Demoted ranks (:attr:`HierPlan.demoted`) contribute NOTHING —
+        they skip phases 1–2 and receive the result in phase 3, so a
+        persistent straggler stops serializing the ring (the source
+        paper's adaptive peer selection). On exact payloads the result
+        is bit-identical to the flat segmented walk over the active
+        set; with the codec, phase 2's once-per-owner quantization
+        keeps heads bit-identical and phase 3 relays those exact f32
+        bytes.
+
+        Messages reuse the flat walk's naming discipline: intra legs
+        rendezvous on ``w.name`` (directions disambiguate reduce vs
+        broadcast, like the graph walks' (reduce, bcast) pairs), the
+        inter ring on ``w.name:x:{rs,ag}{step}`` — disjoint from any
+        flat walk name, so a peer that missed the lockstep adoption
+        fails on a named rendezvous, never reduces into the wrong
+        buffer."""
+        plan = self._hier_plan
+        if plan is None or plan.size != self.size:
+            # stale plan (resize raced the flip) — the flat walk is
+            # always correct
+            self._run_segmented(w, cancel=cancel, wire=wire)
+            return
+        if w.is_empty:
+            w.forward()
+            return
+        dem = set(plan.demoted)
+        n = self.size
+        heads = list(plan.heads)
+        # phase 1: intra star reduce, members → head (exact f32)
+        reduce_g = Graph(n)
+        for head, grp in zip(plan.heads, plan.groups):
+            members = [r for r in grp if r != head and r not in dem]
+            if members:
+                reduce_g.add_edge(head, head)
+                for r in members:
+                    reduce_g.add_edge(r, head)
+        prev_label = self._wire_label_override
+        self._wire_label_override = "HIER_INTRA"
+        try:
+            self._run_graphs(w, [reduce_g], cancel, None)
+        finally:
+            self._wire_label_override = prev_label
+        # phase 2: segmented ring over the heads, INPLACE over the
+        # group-reduced recv (non-heads forward(), a no-op inplace)
+        wx = Workspace(send=w.recv, recv=w.recv, op=w.op,
+                       name=f"{w.name}:x")
+        self._run_segmented(wx, ranks=heads, cancel=cancel, wire=wire)
+        # phase 3: intra star broadcast, head → every member (demoted
+        # included), inplace so the head's forward() keeps its result
+        bcast_g = Graph(n)
+        for head, grp in zip(plan.heads, plan.groups):
+            for r in grp:
+                if r != head:
+                    bcast_g.add_edge(head, r)
+        wb = Workspace(send=w.recv, recv=w.recv, op=w.op, name=w.name)
+        self._wire_label_override = "HIER_INTRA"
+        try:
+            self._run_graphs(wb, [bcast_g], cancel, None)
+        finally:
+            self._wire_label_override = prev_label
 
     # ------------------------------------------------------------------
     # chunked graph walks

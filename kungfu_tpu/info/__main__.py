@@ -492,6 +492,35 @@ def _render_ring_lines(doc: dict, peers: list, idx: dict) -> list:
             not _same_cycle(list(active), list(peers))
         ) else " (rank order)"
         lines.append(f"active ring:    {fmt(active)}{star}")
+    # two-level hierarchy (ISSUE 19): the workers' exported roles name
+    # host groups, the head carrying each group's inter-host leg, and
+    # demoted peers (▽ — zero-weight, served by broadcast)
+    roles = ring.get("role") or {}
+    hier = {
+        p: r for p, r in roles.items()
+        if isinstance(r, dict) and r.get("level") != "flat"
+    }
+    if hier:
+        groups: dict = {}
+        for p, r in hier.items():
+            groups.setdefault(int(r.get("group") or 0), []).append((p, r))
+
+        def member(p: str, r: dict) -> str:
+            return f"[{idx.get(p, '?')}]" + (
+                "▽" if r.get("role") == "demoted" else "")
+
+        parts = []
+        for g in sorted(groups):
+            members = sorted(groups[g],
+                             key=lambda kv: idx.get(kv[0], len(idx)))
+            head = next(
+                (p for p, r in members if r.get("role") == "head"), None)
+            body = ",".join(member(p, r) for p, r in members)
+            htag = f"|h[{idx[head]}]" if head in idx else ""
+            parts.append("{" + body + htag + "}")
+        tail = " (▽ demoted)" if any(
+            r.get("role") == "demoted" for r in hier.values()) else ""
+        lines.append("hierarchy:      " + "→".join(parts) + tail)
     bw = [
         [
             (doc.get("edges", {}).get(src, {}).get(dst, {}) or {}).get("bw")

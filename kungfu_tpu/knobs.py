@@ -488,17 +488,30 @@ _knob("KF_CONFIG_ZERO", "",
       default_doc="off")
 _knob("KF_CONFIG_REPLAN", "",
       _choice("KF_CONFIG_REPLAN",
-              ("off", "ring", "ring+segments", "auto"), empty_as="off"),
+              ("off", "ring", "ring+segments", "auto", "hier"),
+              empty_as="off"),
       "Measured-topology re-planning of the segmented ring: `ring` lets "
       "the vote-driven re-plan reorder ring neighbours from the measured "
       "link matrix, `ring+segments` additionally sizes segments by "
-      "measured per-peer throughput, `auto` == `ring+segments`, `off` "
-      "keeps the naive rank-order ring. Cluster-agreed: every peer must "
-      "run the same lockstep re-plan rounds (and the adopted plan "
-      "decides segment bounds), so it is checked by "
+      "measured per-peer throughput, `auto` == `ring+segments`, `hier` "
+      "derives TWO-LEVEL plans (per-host intra reduce/broadcast × an "
+      "inter-host ring over elected heads, falling back to the flat "
+      "measured ring on a single host group) and enables straggler "
+      "demotion, `off` keeps the naive rank-order ring. Cluster-agreed: "
+      "every peer must run the same lockstep re-plan rounds (and the "
+      "adopted plan decides segment bounds), so it is checked by "
       "`check_knob_consensus` at every session epoch.",
       section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
       default_doc="off")
+_knob("KF_REPLAN_DEMOTE_PATIENCE", "3", _int,
+      "Closed decision-ledger windows the SAME peer must stay elected "
+      "critical (with straggler cause ≠ network-transient) before "
+      "`ReplanPolicy` votes it into the demoted role under "
+      "`KF_CONFIG_REPLAN=hier`; a recovered peer is promoted back after "
+      "the same number of clean windows. Cluster-agreed: demotion flips "
+      "the adopted plan's rendezvous dataflow, so every peer must apply "
+      "the same patience.",
+      section=_SEC_ENGINE, kind="int", strict=True, consensus=True)
 _knob("KF_CONFIG_ASYNC_QUEUE", "2", _int,
       "Async scheduler launch-queue depth: how many packed buckets may "
       "sit between the pack and walk stages (bounds live pooled staging "
@@ -555,7 +568,13 @@ _knob("KF_SHAPE_LINKS", "", _str,
       "and `jitter:<ms>` (deterministic pseudo-random 0..jitter extra). "
       "`dst` is a `host:port` peer spec or `*`; `src` (optional) "
       "restricts the entry to the sender with that peer spec. "
-      "Local-only test/bench harness, never set in production.",
+      "`uplink:<host>=bw:rate` entries model a SHARED host uplink: all "
+      "senders matching `<host>` (a bare hostname, or a `|`-joined "
+      "member list of peer specs for single-host harnesses) drain ONE "
+      "cross-process token bucket (file-locked mmap) for bytes leaving "
+      "the host — per-edge buckets cannot model uplink contention "
+      "(ISSUE 19). Local-only test/bench harness, never set in "
+      "production.",
       section=_SEC_DEBUG, kind="str")
 _knob("KF_TEST_SLOW_EDGE", "", _str,
       "DEPRECATED alias of `KF_SHAPE_LINKS`: `[src>]dst=ms` parses as "

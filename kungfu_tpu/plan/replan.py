@@ -144,9 +144,131 @@ class RingPlan:
         return f"{arrow}{w}"
 
 
-def plan_digest(plan: Optional[RingPlan]) -> bytes:
+@dataclasses.dataclass(frozen=True)
+class HierPlan:
+    """A measured-topology TWO-LEVEL plan (ISSUE 19): per-host intra
+    star (reduce members → a host head over the fast local links) × an
+    inter-host ring over the heads (the wire-codec-eligible DCN leg) ×
+    an intra broadcast back out — the 2D hierarchical all-reduce shape
+    arXiv:1909.09756 scales to pod size.
+
+    ``groups`` are the host groups in INTER-RING order; each group
+    tuple lists its members with the elected head FIRST. ``heads`` is
+    the per-group head (``heads[i] == groups[i][0]``), so the inter
+    ring is ``heads[0] → heads[1] → … → heads[0]``. ``demoted`` ranks
+    stay members of their group (they receive the result in the final
+    broadcast) but contribute nothing: excluded from head election,
+    from the inter ring, and from the reduce — the source paper's
+    adaptive peer selection, a persistent straggler moved to a backup
+    role instead of serializing the ring.
+
+    Byte serialization is canonical exactly like :class:`RingPlan` —
+    adoption walks the digest, so a diverged derivation is a named
+    error, never a hang."""
+
+    groups: Tuple[Tuple[int, ...], ...]
+    heads: Tuple[int, ...]
+    demoted: Tuple[int, ...] = ()
+    gain: float = 1.0
+
+    def __post_init__(self):
+        members = [r for g in self.groups for r in g]
+        k = len(members)
+        if sorted(members) != list(range(k)):
+            raise ValueError(
+                f"groups must partition 0..{k - 1}: {self.groups}"
+            )
+        if len(self.heads) != len(self.groups):
+            raise ValueError(
+                f"{len(self.heads)} heads for {len(self.groups)} groups"
+            )
+        for head, grp in zip(self.heads, self.groups):
+            if not grp or grp[0] != head:
+                raise ValueError(
+                    f"head {head} must lead its group {grp}"
+                )
+            if head in self.demoted:
+                raise ValueError(f"head {head} cannot be demoted")
+        if list(self.demoted) != sorted(set(self.demoted)):
+            raise ValueError(f"demoted must be sorted unique: "
+                             f"{self.demoted}")
+        for d in self.demoted:
+            if d not in members:
+                raise ValueError(f"demoted rank {d} not in any group")
+
+    @property
+    def size(self) -> int:
+        return sum(len(g) for g in self.groups)
+
+    def group_of(self, rank: int) -> int:
+        for gi, g in enumerate(self.groups):
+            if rank in g:
+                return gi
+        raise ValueError(f"rank {rank} not in plan")
+
+    def active(self) -> Tuple[int, ...]:
+        """Contributing ranks (everyone not demoted), in group order."""
+        dem = set(self.demoted)
+        return tuple(
+            r for g in self.groups for r in g if r not in dem
+        )
+
+    def to_bytes(self) -> bytes:
+        return json.dumps(
+            {
+                "kind": "hier",
+                "groups": [list(g) for g in self.groups],
+                "heads": list(self.heads),
+                "demoted": list(self.demoted),
+                "gain": round(float(self.gain), 6),
+            },
+            sort_keys=True, separators=(",", ":"),
+        ).encode()
+
+    def digest(self) -> bytes:
+        return hashlib.blake2b(self.to_bytes(), digest_size=16).digest()
+
+    def describe(self) -> str:
+        parts = []
+        for head, grp in zip(self.heads, self.groups):
+            inner = ",".join(
+                (f"{r}▽" if r in self.demoted else str(r))
+                for r in grp
+            )
+            parts.append(f"[{inner}|h{head}]")
+        return "→".join(parts)
+
+    def as_ring_plan(self) -> RingPlan:
+        """Flat projection for everything that thinks in one ring: the
+        ZeRO shard layout (``owned_bounds``), the ring-position gauges,
+        and the segmented RS/AG legs. Order concatenates the groups in
+        inter-ring order (rotated so rank 0 leads, rings being
+        rotation-invariant); demoted ranks carry ZERO segment weight —
+        an empty owned shard, no update work parked on a straggler."""
+        flat = [r for g in self.groups for r in g]
+        zero = flat.index(0)
+        order = tuple(flat[zero:] + flat[:zero])
+        k = len(order)
+        weights: Optional[Tuple[float, ...]] = None
+        if self.demoted:
+            rank_w = [0.0 if r in self.demoted else 1.0
+                      for r in range(k)]
+            total = sum(rank_w)
+            if total > 0:
+                rank_w = [w / total for w in rank_w]
+            weights = tuple(
+                round(float(x), 9)
+                for x in segment_weights(order, rank_w)
+            )
+        return RingPlan(order=order, weights=weights,
+                        gain=round(float(self.gain), 6))
+
+
+def plan_digest(plan) -> bytes:
     """Digest of a possibly-absent plan (None = the naive rank-order
-    ring with equal segments) — the bytes the adoption consensus walks."""
+    ring with equal segments) — the bytes the adoption consensus walks.
+    Accepts :class:`RingPlan` or :class:`HierPlan` (canonical bytes
+    disambiguate the two)."""
     return plan.digest() if plan is not None else b"naive-ring"
 
 
@@ -340,3 +462,195 @@ def derive_plan(
     if cf > 0.0 and np.isfinite(cf):
         gain = min(gain, 1.0 / max(min(cf, 1.0), 1e-6))
     return RingPlan(order=order, weights=weights, gain=round(gain, 6))
+
+
+# ---------------------------------------------------------------------------
+# two-level (hierarchical) plans — ISSUE 19
+# ---------------------------------------------------------------------------
+
+# a measured matrix is considered bimodal (fast intra-host links vs
+# slow cross-host links) when the edge values split at a log-gap of at
+# least this ratio; below it, clustering falls back to the static host
+# partition (the measurement cannot distinguish the levels)
+HIER_BIMODAL_RATIO = 4.0
+
+
+def cluster_hosts(
+    bw: np.ndarray,
+    fallback: Sequence[Sequence[int]] = (),
+) -> List[List[int]]:
+    """Group ranks into host-like clusters from the MEASURED matrix:
+    symmetrize (max of the two directions), sort the edge estimates,
+    cut at the largest log-gap, and union-find the edges above the cut
+    — intra-host links (shm/loopback) measure orders of magnitude
+    faster than the DCN, so the gap is the host boundary.
+
+    Deterministic function of the matrix bytes (cluster-safety: every
+    peer derives identical groups). Falls back to ``fallback`` (the
+    static host partition, each inner list sorted) when the matrix is
+    unmeasured, unimodal (gap ratio < :data:`HIER_BIMODAL_RATIO`), or
+    the cut yields a degenerate grouping; an empty fallback means
+    "no grouping" ([])."""
+    m = np.asarray(bw, np.float64)
+    k = int(m.shape[0])
+    fb = [sorted(int(r) for r in g) for g in fallback if len(g)]
+    fb.sort(key=lambda g: g[0])
+    if k < 2 or m.shape != (k, k):
+        return fb
+    sym = np.maximum(m, m.T)
+    mask = np.isfinite(sym) & (sym > 0)
+    np.fill_diagonal(mask, False)
+    iu = np.triu_indices(k, 1)
+    vals = sym[iu][mask[iu]]
+    if vals.size < 2:
+        return fb
+    s = np.sort(vals)
+    logs = np.log(s)
+    gaps = np.diff(logs)
+    gi = int(np.argmax(gaps))
+    ratio = float(s[gi + 1] / s[gi]) if s[gi] > 0 else 0.0
+    if not np.isfinite(ratio) or ratio < HIER_BIMODAL_RATIO:
+        return fb
+    thresh = float(np.sqrt(s[gi] * s[gi + 1]))  # geometric midpoint
+    # union-find over edges faster than the cut
+    parent = list(range(k))
+
+    def find(x: int) -> int:
+        while parent[x] != x:
+            parent[x] = parent[parent[x]]
+            x = parent[x]
+        return x
+
+    for i in range(k):
+        for j in range(i + 1, k):
+            if mask[i, j] and sym[i, j] > thresh:
+                ri, rj = find(i), find(j)
+                if ri != rj:
+                    parent[max(ri, rj)] = min(ri, rj)
+    comps: dict = {}
+    for r in range(k):
+        comps.setdefault(find(r), []).append(r)
+    groups = sorted(comps.values(), key=lambda g: g[0])
+    if len(groups) < 2 or len(groups) == k:
+        return fb  # one blob or all singletons: the cut told us nothing
+    return [sorted(g) for g in groups]
+
+
+def _cross_group_bw(
+    sym: np.ndarray, rank: int, own: Sequence[int]
+) -> float:
+    """Mean measured bandwidth from ``rank`` to ranks OUTSIDE its group
+    — the head-election score (the head carries the uplink leg)."""
+    k = sym.shape[0]
+    own_set = set(own)
+    vals = [
+        float(sym[rank, j]) for j in range(k)
+        if j not in own_set
+        and np.isfinite(sym[rank, j]) and sym[rank, j] > 0
+    ]
+    return sum(vals) / len(vals) if vals else 0.0
+
+
+def derive_hier_plan(
+    bw: np.ndarray,
+    hosts: Sequence[Sequence[int]] = (),
+    mode: str = "hier",
+    current=None,
+    compute_frac: float = 0.0,
+    demoted: Sequence[int] = (),
+) -> Optional[HierPlan]:
+    """Turn the merged k×k matrix into a two-level :class:`HierPlan`,
+    or None when a hierarchy would be a no-op: fewer than two host
+    groups (nothing to nest), a group left with no contributing member
+    (every candidate head demoted), or a derivation byte-identical to
+    ``current``.
+
+    Pure function of (matrix bytes, hosts, mode, current, compute_frac,
+    demoted) — same determinism contract as :func:`derive_plan`; the
+    caller (``HostSession.check_replan``) feeds cluster-agreed inputs
+    only. Host grouping prefers the measured clustering
+    (:func:`cluster_hosts`) and falls back to the static ``hosts``
+    partition; head election takes the highest measured cross-group
+    bandwidth (ties to the lowest rank); the inter-host ring over the
+    heads is :func:`ring_order` on the head submatrix.
+
+    Predicted ``gain`` compares serialized bytes/bandwidth of the flat
+    ring (2·(k-1)/k·N at its min edge) against the two-level walk
+    (2·(H-1)/H·N at the min inter-head edge + 2·N at the min intra
+    edge), Amdahl-clamped by ``compute_frac`` like :func:`derive_plan`
+    — a prediction the decision ledger grades with a measured verdict."""
+    if mode in ("off", ""):
+        return None
+    m = np.asarray(bw, np.float64)
+    k = int(m.shape[0])
+    if k < 2 or m.shape != (k, k):
+        return None
+    dem = tuple(sorted({int(d) for d in demoted if 0 <= int(d) < k}))
+    groups = cluster_hosts(m, fallback=hosts)
+    if len(groups) < 2:
+        return None
+    if sorted(r for g in groups for r in g) != list(range(k)):
+        return None  # partial partition: refuse to guess
+    sym = np.maximum(m, m.T)
+    np.fill_diagonal(sym, 0.0)
+    heads: List[int] = []
+    ordered_groups: List[List[int]] = []
+    for g in groups:
+        cands = [r for r in g if r not in dem]
+        if not cands:
+            return None  # a fully-demoted host has no head to carry it
+        head = max(
+            cands,
+            key=lambda r: (_cross_group_bw(sym, r, g), -r),
+        )
+        heads.append(head)
+        ordered_groups.append([head] + [r for r in g if r != head])
+    # inter-host ring over the heads: ring_order on the head submatrix
+    # (heads ascending → index 0 is the lowest head, which ring_order
+    # pins first — canonical across peers)
+    hsorted = sorted(range(len(heads)), key=lambda i: heads[i])
+    sub = m[np.ix_([heads[i] for i in hsorted],
+                   [heads[i] for i in hsorted])]
+    inter = ring_order(sub)
+    perm = [hsorted[i] for i in inter]
+    heads = [heads[i] for i in perm]
+    ordered_groups = [ordered_groups[i] for i in perm]
+    H = len(heads)
+    # predicted gain: serialized bytes/bandwidth, flat vs two-level
+    flat_order = (
+        current.as_ring_plan().order if isinstance(current, HierPlan)
+        else (current.order if isinstance(current, RingPlan)
+              else tuple(range(k)))
+    )
+    flat_min = min_edge_bw(m, flat_order)
+    inter_min = min_edge_bw(
+        m, [heads[i] for i in range(H)]
+    ) if H > 1 else None
+    intra_vals = [
+        float(sym[i, j])
+        for g in ordered_groups
+        for i in g for j in g
+        if i != j and np.isfinite(sym[i, j]) and sym[i, j] > 0
+    ]
+    intra_min = min(intra_vals) if intra_vals else None
+    gain = 1.0
+    if flat_min and inter_min and intra_min:
+        flat_cost = 2.0 * (k - 1) / k / flat_min
+        hier_cost = (
+            2.0 * (H - 1) / H / inter_min + 2.0 / intra_min
+        )
+        if hier_cost > 0:
+            gain = flat_cost / hier_cost
+    cf = float(compute_frac)
+    if cf > 0.0 and np.isfinite(cf):
+        gain = min(gain, 1.0 / max(min(cf, 1.0), 1e-6))
+    plan = HierPlan(
+        groups=tuple(tuple(g) for g in ordered_groups),
+        heads=tuple(heads),
+        demoted=dem,
+        gain=round(float(gain), 6),
+    )
+    if current is not None and hasattr(current, "to_bytes") \
+            and current.to_bytes() == plan.to_bytes():
+        return None
+    return plan

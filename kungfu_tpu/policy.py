@@ -335,7 +335,30 @@ class ReplanPolicy(BasePolicy):
     ``links/oldest_row_age_s`` and it exceeds ``max_row_age_s``
     (default ``KF_AGG_LINK_MAX_AGE_S``; 0 disables the gate) this peer
     refuses to VOTE yes — the ``check_replan`` collective still runs
-    in lockstep so peers with fresh data stay in sync."""
+    in lockstep so peers with fresh data stay in sync.
+
+    Adaptive demotion (ISSUE 19, ``KF_CONFIG_REPLAN=hier`` only): the
+    planner's segment weights are bandwidth-only, so this policy feeds
+    the OTHER measured planes in — ``step/critical_peer`` +
+    ``step/critical_edge`` (who the cluster keeps waiting on),
+    ``resource/saturated_peers`` and ``cluster/straggler_causes`` (WHY:
+    network vs compute vs memory). A peer that stays elected critical
+    for ``demote_patience`` consecutive closed ledger windows with a
+    cause ≠ network (a slow LINK is the flat re-planner's job; demotion
+    is for peers that are themselves the bottleneck) is proposed into
+    the demoted role via ``HostSession.check_demote`` — a lockstep
+    majority vote, run every ``interval_steps`` on every peer exactly
+    like ``check_replan``. Demoted = zero-weight segments + excluded
+    from the inter-host ring, still receiving results by broadcast.
+    The adoption opens a ``peer_demoted`` decision record; the ledger
+    grades it against measured step times, and if it lands in
+    ``decision/regressed`` this policy votes the peer straight back
+    (rollback). A demoted peer that stays un-flagged for
+    ``demote_patience`` clean windows is promoted back on recovery."""
+
+    # a straggler cause that re-planning/demotion treats as transient
+    # network weather — routed around, never demoted for
+    NETWORK_CAUSES = ("network", "unknown", None, "")
 
     def __init__(
         self,
@@ -344,6 +367,7 @@ class ReplanPolicy(BasePolicy):
         min_gain: float = 1.05,
         session_supplier: Optional[Callable[[], object]] = None,
         max_row_age_s: Optional[float] = None,
+        demote_patience: Optional[int] = None,
     ):
         if interval_steps < 1:
             raise ValueError("interval_steps must be >= 1")
@@ -356,10 +380,23 @@ class ReplanPolicy(BasePolicy):
             except (TypeError, ValueError):
                 max_row_age_s = 60.0
         self.max_row_age_s = max_row_age_s
+        if demote_patience is None:
+            try:
+                demote_patience = int(knobs.get("KF_REPLAN_DEMOTE_PATIENCE"))
+            except (TypeError, ValueError):
+                demote_patience = 3
+        self.demote_patience = max(1, demote_patience)
         self._session_supplier = session_supplier
         self._edge = None  # the persistently-named edge being watched
         self._streak = 0
         self._last_update = None
+        # demotion watch (ISSUE 19): per-peer counts of closed ledger
+        # windows spent elected critical (with a demotable cause) /
+        # spent clean while demoted — the patience substrate
+        self._crit_windows: dict = {}   # peer label -> windows critical
+        self._clean_windows: dict = {}  # rank -> windows un-flagged
+        self._window_mark = 0           # ctx.step at last window close
+        self._demote_update = None
 
     def _session(self):
         if self._session_supplier is not None:
@@ -393,6 +430,98 @@ class ReplanPolicy(BasePolicy):
         else:
             self._edge, self._streak = edge, 1
 
+    @staticmethod
+    def _rank_of(sess, label) -> Optional[int]:
+        peers = getattr(sess, "peers", None)
+        if peers is None or label is None:
+            return None
+        try:
+            from kungfu_tpu.plan.peer import PeerID
+
+            return peers.rank(PeerID.parse(str(label)))
+        except Exception as e:  # noqa: BLE001 - unparseable label = unknown peer
+            log.debug("replan policy: unmappable peer label %r: %s", label, e)
+            return None
+
+    @staticmethod
+    def _label_of(sess, rank: int) -> Optional[str]:
+        peers = getattr(sess, "peers", None)
+        try:
+            return str(peers[rank]) if peers is not None else None
+        except Exception as e:  # noqa: BLE001 - rank outside the peer list
+            log.debug("replan policy: no label for rank %s: %s", rank, e)
+            return None
+
+    def _observe_demotion(self, ctx: "PolicyContext", sess) -> None:
+        """Close a demotion-patience window: one ledger measurement
+        window (``DecisionLedger.window`` steps) with a fresh cluster
+        refresh. Inside each closed window, count whether the SAME
+        peer stayed elected critical with a demotable cause — and, for
+        already-demoted peers, whether they stayed clean (the recovery
+        counter promotion keys off)."""
+        from kungfu_tpu.telemetry import decisions as _tdec
+
+        window = max(1, int(getattr(_tdec.get_ledger(), "window", 16)))
+        if ctx.step - self._window_mark < window:
+            return
+        update = ctx.metrics.get("cluster/updated_at")
+        if update is not None and update == self._demote_update:
+            return  # no fresh cluster view: the window cannot close
+        self._window_mark = ctx.step
+        self._demote_update = update
+        crit = ctx.metrics.get("step/critical_peer")
+        causes = ctx.metrics.get("cluster/straggler_causes") or {}
+        saturated = set(ctx.metrics.get("resource/saturated_peers") or ())
+        demotable = crit is not None and (
+            causes.get(crit) not in self.NETWORK_CAUSES
+            or crit in saturated  # direct compute measurement
+        )
+        if demotable:
+            self._crit_windows = {
+                crit: self._crit_windows.get(crit, 0) + 1
+            }
+        else:
+            # a clean window (or a network-caused one) breaks the streak
+            self._crit_windows.clear()
+        flagged = set(ctx.metrics.get("cluster/stragglers") or ())
+        demoted = tuple(getattr(sess, "demoted_peers", tuple)())
+        self._clean_windows = {
+            r: (
+                self._clean_windows.get(r, 0) + 1
+                if (lbl := self._label_of(sess, r)) is not None
+                and lbl not in flagged and lbl != crit
+                else 0
+            )
+            for r in demoted
+        }
+
+    def _demote_proposal(self, ctx: "PolicyContext", sess):
+        """(demote_rank, promote_rank) this peer votes for — either may
+        be None; the lockstep majority decides."""
+        demoted = set(getattr(sess, "demoted_peers", tuple)())
+        promote = None
+        regressed = ctx.metrics.get("decision/regressed") or []
+        if "peer_demoted" in regressed and demoted:
+            # the ledger measured the demotion throughput-hostile:
+            # roll it back rather than wait out a recovery
+            promote = min(demoted)
+        else:
+            clean = sorted(
+                r for r, n in self._clean_windows.items()
+                if n >= self.demote_patience and r in demoted
+            )
+            if clean:
+                promote = clean[0]
+        demote = None
+        for label, n in sorted(self._crit_windows.items()):
+            if n < self.demote_patience:
+                continue
+            rank = self._rank_of(sess, label)
+            if rank is not None and rank not in demoted and rank != promote:
+                demote = rank
+                break
+        return demote, promote
+
     def after_step(self, ctx: "PolicyContext") -> None:
         self._observe(ctx)
         if ctx.step == 0 or ctx.step % self.interval_steps:
@@ -415,6 +544,23 @@ class ReplanPolicy(BasePolicy):
             self._edge, self._streak = None, 0
             ctx.metrics["replan/last_order"] = list(plan.order)
             ctx.metrics["replan/predicted_gain"] = plan.gain
+        # adaptive demotion (ISSUE 19): a second lockstep round, run on
+        # every peer at the same step boundary exactly like the re-plan
+        # vote (check_demote is a no-op collective-free return outside
+        # KF_CONFIG_REPLAN=hier, which is cluster-agreed)
+        if getattr(sess, "replan_mode", "") == "hier" \
+                and hasattr(sess, "check_demote"):
+            self._observe_demotion(ctx, sess)
+            demote, promote = self._demote_proposal(ctx, sess)
+            adopted = sess.check_demote(demote=demote, promote=promote)
+            if adopted is not None:
+                self._crit_windows.clear()
+                self._clean_windows.clear()
+                now_demoted = [
+                    int(r) for r in getattr(sess, "demoted_peers", tuple)()
+                ]
+                ctx.metrics["replan/demoted"] = now_demoted
+                ctx.metrics["replan/last_order"] = list(adopted.order)
 
 
 class _Scope:

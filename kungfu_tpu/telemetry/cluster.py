@@ -96,6 +96,10 @@ LINK_MSGS = "kungfu_link_tx_messages_total"
 # /cluster/links can render the ACTIVE ring next to the measured matrix
 RING_POS = "kungfu_topology_ring_position"
 RING_NEXT = "kungfu_topology_ring_next"
+# two-level plan role (ISSUE 19): each worker exports its level ("inter"
+# head / "intra" member / "flat") and role, value = host-group index, so
+# the links view can render the ACTIVE hierarchy (groups, heads, demoted)
+RING_ROLE = "kungfu_topology_ring_role"
 
 CLOCK_HEADER = "X-KF-Perf-Now-Us"
 
@@ -251,6 +255,7 @@ def parse_worker_page(text: str) -> dict:
     links: Dict[str, dict] = {}
     ring_pos = None
     ring_next = None
+    ring_role = None
     _link_key = {
         LINK_BW: "bw", LINK_LAT: "latency_s",
         LINK_BYTES: "tx_bytes", LINK_MSGS: "tx_messages",
@@ -270,6 +275,10 @@ def parse_worker_page(text: str) -> dict:
             ring_pos = int(s.value)
         elif s.name == RING_NEXT and s.value:
             ring_next = s.labels_dict().get("dst") or ring_next
+        elif s.name == RING_ROLE:
+            d = s.labels_dict()
+            ring_role = {"level": d.get("level"), "role": d.get("role"),
+                         "group": int(s.value)}
         elif s.name in _link_key:
             dst = s.labels_dict().get("dst")
             if dst:
@@ -284,6 +293,7 @@ def parse_worker_page(text: str) -> dict:
         "links": links,
         "ring_pos": ring_pos,
         "ring_next": ring_next,
+        "ring_role": ring_role,
     }
 
 
@@ -309,6 +319,7 @@ def parsed_from_doc(doc: dict) -> dict:
     parsed.setdefault("links", {})
     parsed.setdefault("ring_pos", None)
     parsed.setdefault("ring_next", None)
+    parsed.setdefault("ring_role", None)
     return parsed
 
 
@@ -426,6 +437,8 @@ class PeerState:
         # current ring order and its successor peer label
         self.ring_pos: Optional[int] = None
         self.ring_next: Optional[str] = None
+        # two-level role (ISSUE 19): {"level","role","group"} or None
+        self.ring_role: Optional[dict] = None
         # per-(peer, endpoint) freshness (ISSUE 18 fix): a peer failing
         # ONE endpoint mid-sweep used to leave that plane's previous
         # payload silently current — last_ok only tracked /metrics.
@@ -793,7 +806,7 @@ class TelemetryAggregator:
         # and its link row: a dead peer's frozen bandwidth estimates
         # would keep steering topology re-planning hours later
         st.links = {}
-        st.ring_pos = st.ring_next = None
+        st.ring_pos = st.ring_next = st.ring_role = None
         # scale mode: the sampled-matrix cache row too, for the same
         # reason (and a dead incarnation's delta cursors are garbage
         # to the respawn's restarted seq spaces)
@@ -815,6 +828,7 @@ class TelemetryAggregator:
         st.links = parsed.get("links") or {}
         st.ring_pos = parsed.get("ring_pos")
         st.ring_next = parsed.get("ring_next")
+        st.ring_role = parsed.get("ring_role")
         st.coll_sum = parsed.get("coll_sum")
         st.bytes_tx, st.bytes_rx = parsed.get("bytes_tx"), parsed.get("bytes_rx")
         st.reported_rtt = parsed.get("reported_rtt")
@@ -1510,23 +1524,7 @@ class TelemetryAggregator:
         # only published when every scraped peer reported a distinct
         # position (mid-re-plan or partially-scraped clusters return
         # null rather than a half-true ring)
-        positions = {
-            st.label: st.ring_pos for st in self.peers()
-            if st.ring_pos is not None
-        }
-        order = None
-        if positions and len(positions) == len(self.peers()):
-            by_pos = sorted(positions.items(), key=lambda kv: kv[1])
-            if [p for _, p in by_pos] == list(range(len(by_pos))):
-                order = [label for label, _ in by_pos]
-        doc["ring"] = {
-            "order": order,
-            "position": positions,
-            "next": {
-                st.label: st.ring_next for st in self.peers()
-                if st.ring_next is not None
-            },
-        }
+        doc["ring"] = self._ring_doc()
         doc["plane"] = self.plane_envelope()
         return doc
 
@@ -1549,6 +1547,13 @@ class TelemetryAggregator:
             "next": {
                 st.label: st.ring_next for st in self.peers()
                 if st.ring_next is not None
+            },
+            # two-level roles (ISSUE 19): per-peer {level, role, group}
+            # — "inter"/"head" marks a host head, "intra"/"demoted" a
+            # demoted peer; all-"flat" (or absent) = no hierarchy
+            "role": {
+                st.label: st.ring_role for st in self.peers()
+                if st.ring_role is not None
             },
         }
 
@@ -2546,6 +2551,15 @@ def health_signals(
             if info.get("straggler_score") is not None
         },
         "cluster/self_straggler": me in stragglers if me else False,
+        # the measured cause behind each flagged straggler (ISSUE 16
+        # classification) — the demotion policy (ISSUE 19) only acts on
+        # non-network causes: a slow LINK is the flat re-planner's job,
+        # demotion is for peers that are themselves the bottleneck
+        "cluster/straggler_causes": {
+            p: info.get("straggler_cause")
+            for p, info in snap.get("peers", {}).items()
+            if info.get("straggler_cause")
+        },
     }
     links = snap.get("links") or {}
     if links.get("min_bw") is not None:

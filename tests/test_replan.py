@@ -912,3 +912,589 @@ def test_info_links_renders_ring_lines():
     # no ring block at all: matrix still renders
     doc.pop("ring")
     assert "predicted ring:" in render_links(doc)
+
+
+# ---------------------------------------------------------------------------
+# two-level plans (ISSUE 19): pure algebra + the live hierarchical walk
+# ---------------------------------------------------------------------------
+
+def _dcn_matrix(k, hosts, intra=1000.0, cross=5.0):
+    m = np.full((k, k), cross)
+    for g in hosts:
+        for i in g:
+            for j in g:
+                if i != j:
+                    m[i, j] = intra
+    np.fill_diagonal(m, 0.0)
+    return m
+
+
+def test_cluster_hosts_bimodal_and_fallback():
+    hosts = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    m = _dcn_matrix(8, hosts)
+    assert rp.cluster_hosts(m) == hosts
+    # clustering is a pure function of the matrix bytes
+    assert rp.cluster_hosts(m.copy()) == hosts
+    # near-uniform matrix (ratio below HIER_BIMODAL_RATIO): static
+    # partition wins; empty fallback means no grouping
+    flat = _dcn_matrix(8, hosts, intra=12.0, cross=5.0)
+    assert rp.cluster_hosts(flat, fallback=hosts) == hosts
+    assert rp.cluster_hosts(flat) == []
+    # unmeasured matrix: fallback too
+    assert rp.cluster_hosts(np.zeros((4, 4)), fallback=[[0, 1], [2, 3]]) \
+        == [[0, 1], [2, 3]]
+
+
+def test_hier_plan_validation_and_bytes():
+    plan = rp.HierPlan(groups=((1, 0), (3, 2)), heads=(1, 3))
+    assert plan.size == 4 and plan.group_of(2) == 1
+    assert plan.to_bytes() == rp.HierPlan(
+        groups=((1, 0), (3, 2)), heads=(1, 3)).to_bytes()
+    # demotion changes the canonical bytes (the digest the vote walks)
+    dem = rp.HierPlan(groups=((1, 0), (3, 2)), heads=(1, 3), demoted=(2,))
+    assert dem.digest() != plan.digest()
+    assert dem.active() == (1, 0, 3)
+    assert "▽" in dem.describe()
+    with pytest.raises(ValueError):
+        rp.HierPlan(groups=((0, 1), (3, 2)), heads=(1, 3))  # head not first
+    with pytest.raises(ValueError):
+        rp.HierPlan(groups=((1, 0), (3, 2)), heads=(1, 3), demoted=(3,))
+    with pytest.raises(ValueError):
+        rp.HierPlan(groups=((1, 0), (3,)), heads=(1, 3))  # not a partition
+
+
+def test_hier_plan_flat_projection_zero_weights():
+    """as_ring_plan: demoted ranks own ZERO segment weight — their ZeRO
+    shard is empty, including under n<k payloads (satellite: the
+    weighted_partition zero-weight x short-payload interaction)."""
+    plan = rp.HierPlan(groups=((1, 0), (3, 2)), heads=(1, 3), demoted=(2,))
+    flat = plan.as_ring_plan()
+    assert flat.order[0] == 0
+    assert sorted(flat.order) == [0, 1, 2, 3]
+    # rank 2's owned segment (ring position + 1) carries weight 0
+    pos = flat.order.index(2)
+    assert flat.weights[(pos + 1) % 4] == 0.0
+    assert sum(flat.weights) == pytest.approx(1.0)
+    # n < k: the zero-weight member gets an EMPTY interval and the rest
+    # still tile the payload
+    for count in (1, 2, 3):
+        bounds = rp.weighted_partition(count, flat.weights)
+        sizes = [e - b for b, e in bounds]
+        assert sum(sizes) == count
+        assert sizes[(pos + 1) % 4] == 0
+        ob, oe = topo.owned_segment_bounds(
+            count, 4, 2, order=flat.order, weights=flat.weights
+        )
+        assert ob == oe  # demoted: empty owned shard at every size
+    # undemoted plans project with no weights at all (even split)
+    assert rp.HierPlan(
+        groups=((0, 1), (2, 3)), heads=(0, 2)
+    ).as_ring_plan().weights is None
+
+
+def test_derive_hier_plan_deterministic_and_demote_aware():
+    hosts = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    m = _dcn_matrix(8, hosts)
+    m[3, 4:8] = 9.0  # rank 3: best uplink of host 0
+    m[6, 0:4] = 9.0  # rank 6: best uplink of host 1
+    a = rp.derive_hier_plan(m, hosts=hosts)
+    b = rp.derive_hier_plan(m.copy(), hosts=[list(g) for g in hosts])
+    assert a is not None and a.to_bytes() == b.to_bytes()
+    assert a.heads == (3, 6)  # elected by measured cross-group bw
+    assert a.gain > 1.0
+    # derivation is demotion-aware: the set rides the canonical bytes
+    d = rp.derive_hier_plan(m, hosts=hosts, demoted=[5])
+    assert d.demoted == (5,) and d.digest() != a.digest()
+    # demoting a would-be head re-elects another member
+    d3 = rp.derive_hier_plan(m, hosts=hosts, demoted=[3])
+    assert d3.heads[0] != 3 and 3 in d3.demoted
+    # a fully-demoted host cannot carry a head: not derivable
+    assert rp.derive_hier_plan(
+        np.asarray(_dcn_matrix(4, [[0, 1], [2, 3]])),
+        hosts=[[0, 1], [2, 3]], demoted=[2, 3],
+    ) is None
+    # single host group: nothing to nest
+    assert rp.derive_hier_plan(
+        np.full((4, 4), 100.0), hosts=[[0, 1, 2, 3]]
+    ) is None
+    # current no-op: byte-identical derivation returns None
+    assert rp.derive_hier_plan(m, hosts=hosts, current=a) is None
+
+
+def _hier_test_plan(np_):
+    """A deterministic two-level plan for small np (heads not always
+    the lowest rank, so head election paths are exercised)."""
+    if np_ == 2:
+        return rp.HierPlan(groups=((0,), (1,)), heads=(0, 1))
+    if np_ == 3:
+        return rp.HierPlan(groups=((1, 0), (2,)), heads=(1, 2))
+    return rp.HierPlan(groups=((1, 0), (3, 2)), heads=(1, 3))
+
+
+@pytest.mark.parametrize("np_", [2, 3, 4])
+def test_hier_walk_bit_identical(np_, clusters, monkeypatch):
+    """The two-level walk lands bit-identical results on exact payloads
+    at np in {2,3,4} — including sizes below k and non-multiples."""
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    cluster = clusters(np_)
+    plan = _hier_test_plan(np_)
+    rng = np.random.default_rng(400 + np_)
+    sizes = [1, np_ - 1, np_ + 1, 1000, 1001, 4 * np_ + 3]
+    cases = [(s, dt) for s in sizes for dt in (np.float32, np.int32)]
+    inputs = {
+        (ci, r): rng.integers(-8, 9, s).astype(dt)
+        for ci, (s, dt) in enumerate(cases)
+        for r in range(np_)
+    }
+    want = {
+        ci: sum(inputs[(ci, r)] for r in range(np_))
+        for ci in range(len(cases))
+    }
+    sessions = _sessions(cluster)
+    for s in sessions:
+        s._hier_plan = plan
+        s._ring_plan = plan.as_ring_plan()
+
+    def run(r, sess):
+        for ci, (size, dt) in enumerate(cases):
+            x = inputs[(ci, r)]
+            out = np.empty_like(x)
+            sess.all_reduce(Workspace(
+                send=x, recv=out, op=ReduceOp.SUM,
+                name=f"hier:{np_}:{ci}",
+            ))
+            np.testing.assert_array_equal(
+                out, want[ci],
+                err_msg=f"case {ci} ({size}, {dt}) rank={r}",
+            )
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+
+
+def test_hier_walk_demoted_peer_excluded_but_served(clusters, monkeypatch):
+    """A demoted rank contributes NOTHING (its gradient is dropped from
+    the sum — the backup role) but still receives the reduced result in
+    the post-walk broadcast."""
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    np_ = 4
+    cluster = clusters(np_)
+    plan = rp.HierPlan(groups=((1, 0), (3, 2)), heads=(1, 3), demoted=(2,))
+    sessions = _sessions(cluster)
+    for s in sessions:
+        s._hier_plan = plan
+        s._ring_plan = plan.as_ring_plan()
+    rng = np.random.default_rng(55)
+    inputs = {r: rng.integers(-8, 9, 1003).astype(np.float32)
+              for r in range(np_)}
+    want = sum(inputs[r] for r in plan.active())
+
+    def run(r, sess):
+        out = np.empty_like(inputs[r])
+        sess.all_reduce(Workspace(
+            send=inputs[r], recv=out, op=ReduceOp.SUM, name="hierdem",
+        ))
+        np.testing.assert_array_equal(out, want, err_msg=f"rank {r}")
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+
+
+@pytest.mark.parametrize("np_", [3, 4])
+def test_zero_survives_flat_to_hier_flip(np_, clusters):
+    """ZeRO mid-training re-shard across a flat→hier plan flip: the
+    adopted HierPlan's FLAT projection drives owned_bounds, so the
+    registered listener re-shards exactly like a flat re-plan."""
+    cluster = clusters(np_)
+    sessions = _sessions(cluster)
+    lr, momentum = 0.1, 0.9
+    p0 = _make_params(np_, seed=500 + np_)
+    rng = np.random.default_rng(510 + np_)
+    rounds = [
+        [
+            [rng.integers(-8, 9, p.size).astype(np.float32) for p in p0]
+            for _ in range(np_)
+        ]
+        for _ in range(4)
+    ]
+    ref, _ = _replicated_sgd(p0, rounds, np_, lr, momentum)
+    flat_plan = _test_plan(np_, weighted=True, seed=13)
+    hier_plan = _hier_test_plan(np_)
+    zsessions = {}
+    params = {r: [p.copy() for p in p0] for r in range(np_)}
+
+    def build(r, sess):
+        zsessions[r] = ShardedUpdateSession(
+            params[r], ShardedSGD(lr, momentum=momentum),
+            name=f"hierz{np_}", session=sess,
+        )
+
+    _run_on_all([lambda r=r, s=s: build(r, s) for r, s in enumerate(sessions)])
+    _run_on_all([lambda s=s: s.adopt_replan(flat_plan) for s in sessions])
+    _run_on_all([
+        lambda r=r: [zsessions[r].step(rounds[i][r]) for i in range(2)]
+        for r in range(np_)
+    ])
+    _run_on_all([lambda s=s: s.adopt_replan(hier_plan) for s in sessions])
+    for r, s in enumerate(sessions):
+        assert s.hier_plan() is not None
+        assert s.ring_plan().order == hier_plan.as_ring_plan().order
+        b = zsessions[r]._buckets[0]
+        assert (b.ob, b.oe) == s.owned_bounds(b.total)
+    _run_on_all([
+        lambda r=r: [zsessions[r].step(rounds[i][r]) for i in range(2, 4)]
+        for r in range(np_)
+    ])
+    for r in range(np_):
+        for i, p in enumerate(params[r]):
+            np.testing.assert_array_equal(
+                p, ref[i], err_msg=f"rank {r} param {i} after hier flip"
+            )
+
+
+def test_check_demote_vote_and_promote(clusters):
+    """The lockstep demote round: a majority vote moves the straggler
+    into the demoted role (plan re-derived + adopted identically on
+    every peer, ledger records opened), a promote vote brings it back,
+    and a no-majority round is a no-op."""
+    from kungfu_tpu.telemetry import decisions as tdecisions
+
+    np_ = 4
+    cluster = clusters(np_)
+    sessions = _sessions(cluster)
+    hosts = [[0, 1], [2, 3]]
+    m = _dcn_matrix(np_, hosts)
+    for s in sessions:
+        s.replan_mode = "hier"
+        s.measured_matrix = lambda m=m: m.copy()
+    results = {}
+
+    # no strict majority (2 of 4): no-op
+    _run_on_all([
+        lambda r=r, s=s: results.__setitem__(
+            r, s.check_demote(demote=3 if r < 2 else None, tag="a")
+        )
+        for r, s in enumerate(sessions)
+    ])
+    assert all(v is None for v in results.values())
+    assert all(s.demoted_peers() == () for s in sessions)
+
+    # majority demote of rank 3
+    _run_on_all([
+        lambda r=r, s=s: results.__setitem__(
+            r, s.check_demote(demote=3 if r != 3 else None, tag="b")
+        )
+        for r, s in enumerate(sessions)
+    ])
+    assert all(v is not None for v in results.values())
+    assert all(s.demoted_peers() == (3,) for s in sessions)
+    assert all(s.hier_plan() is not None for s in sessions)
+    assert all(s.hier_plan().heads[1] == 2 for s in sessions)
+    assert any(r.kind == "peer_demoted"
+               for r in tdecisions.get_ledger().records())
+
+    # majority promote brings it back
+    _run_on_all([
+        lambda r=r, s=s: results.__setitem__(
+            r, s.check_demote(promote=3, tag="c")
+        )
+        for r, s in enumerate(sessions)
+    ])
+    assert all(s.demoted_peers() == () for s in sessions)
+    assert any(r.kind == "peer_promoted"
+               for r in tdecisions.get_ledger().records())
+
+
+class _FakeHierSession:
+    """Records check_demote votes; adopts any voted demotion."""
+
+    def __init__(self, size=4):
+        self.size = size
+        self.replan_mode = "hier"
+        self.peers = PeerList(PeerID(f"h{r}", 7000) for r in range(size))
+        self.replan_calls = 0
+        self.votes = []  # (demote, promote)
+        self._demoted = ()
+
+    def check_replan(self, want=False, min_gain=1.05, tag=""):
+        self.replan_calls += 1
+        return None
+
+    def demoted_peers(self):
+        return self._demoted
+
+    def check_demote(self, demote=None, promote=None, tag=""):
+        self.votes.append((demote, promote))
+        new = (set(self._demoted) | ({demote} if demote is not None else set())) \
+            - ({promote} if promote is not None else set())
+        if tuple(sorted(new)) == self._demoted:
+            return None
+        self._demoted = tuple(sorted(new))
+        return rp.RingPlan(order=tuple(range(self.size)), gain=1.0)
+
+
+def test_replan_policy_demotes_persistent_straggler_and_rolls_back():
+    """The demotion watch: the SAME peer elected critical (cause ≠
+    network) for demote_patience closed ledger windows → vote demote;
+    ledger regression on peer_demoted → vote promote (rollback)."""
+    from kungfu_tpu.policy import PolicyContext, ReplanPolicy
+    from kungfu_tpu.telemetry import decisions as tdecisions
+
+    window = tdecisions.get_ledger().window
+    sess = _FakeHierSession()
+    pol = ReplanPolicy(interval_steps=window, patience=99,
+                       demote_patience=2, session_supplier=lambda: sess)
+    ctx = PolicyContext(batch_size=1)
+    ctx.metrics["step/critical_peer"] = "h2:7000"
+    ctx.metrics["cluster/stragglers"] = ["h2:7000"]
+    ctx.metrics["cluster/straggler_causes"] = {"h2:7000": "compute"}
+    for i in range(1, 4):
+        ctx.step = i * window
+        ctx.metrics["cluster/updated_at"] = float(i)
+        pol.after_step(ctx)
+    # window 1 closed a streak of 1 (< patience), window 2 hit 2 → vote
+    assert (2, None) in sess.votes
+    assert sess.demoted_peers() == (2,)
+    assert ctx.metrics["replan/demoted"] == [2]
+    # a NETWORK-caused critical peer never builds a demote streak
+    sess2 = _FakeHierSession()
+    pol2 = ReplanPolicy(interval_steps=window, patience=99,
+                        demote_patience=2, session_supplier=lambda: sess2)
+    ctx2 = PolicyContext(batch_size=1)
+    ctx2.metrics["step/critical_peer"] = "h1:7000"
+    ctx2.metrics["cluster/straggler_causes"] = {"h1:7000": "network"}
+    for i in range(1, 6):
+        ctx2.step = i * window
+        ctx2.metrics["cluster/updated_at"] = float(i)
+        pol2.after_step(ctx2)
+    assert all(d is None for d, _ in sess2.votes)
+    # ledger-measured regression rolls the demotion back immediately
+    ctx.metrics["decision/regressed"] = ["peer_demoted"]
+    ctx.step += window
+    ctx.metrics["cluster/updated_at"] += 1.0
+    pol.after_step(ctx)
+    assert sess.votes[-1][1] == 2
+    assert sess.demoted_peers() == ()
+
+
+def test_replan_policy_promotes_recovered_peer():
+    from kungfu_tpu.policy import PolicyContext, ReplanPolicy
+    from kungfu_tpu.telemetry import decisions as tdecisions
+
+    window = tdecisions.get_ledger().window
+    sess = _FakeHierSession()
+    sess._demoted = (3,)
+    pol = ReplanPolicy(interval_steps=window, patience=99,
+                       demote_patience=2, session_supplier=lambda: sess)
+    ctx = PolicyContext(batch_size=1)
+    # h3 stays clean (not flagged, not critical) for 2 windows → promote
+    for i in range(1, 4):
+        ctx.step = i * window
+        ctx.metrics["cluster/updated_at"] = float(i)
+        pol.after_step(ctx)
+    assert (None, 3) in sess.votes
+    assert sess.demoted_peers() == ()
+
+
+def test_cluster_links_carries_roles_and_info_renders_hierarchy():
+    """The role gauge rides the scrape into _ring_doc (ISSUE 19
+    satellite) and `info links` renders the hierarchy with heads and
+    the demoted ▽ marker."""
+    import pytest as _pytest
+
+    _pytest.importorskip("kungfu_tpu.telemetry.http")
+    from kungfu_tpu.info.__main__ import render_links
+    from kungfu_tpu.telemetry import cluster as tcluster
+    from kungfu_tpu.telemetry import metrics as tmetrics_mod
+    from kungfu_tpu.telemetry.http import TelemetryServer
+
+    workers = []
+    try:
+        for i in range(3):
+            reg = tmetrics_mod.Registry()
+            server = TelemetryServer(0, host="127.0.0.1", registry=reg)
+            server.start()
+            workers.append((reg, server, f"127.0.0.1:{server.port}",
+                            f"http://127.0.0.1:{server.port}"))
+        labels = [w[2] for w in workers]
+        # groups {0,1} (head 0) and {2} (head 2); 1 demoted
+        roles = [("inter", "head", 0), ("intra", "demoted", 0),
+                 ("inter", "head", 1)]
+        for i, (reg, _, label, _) in enumerate(workers):
+            level, role, group = roles[i]
+            reg.gauge(
+                "kungfu_topology_ring_role", "role", ("level", "role")
+            ).labels(level, role).set(group)
+        agg = tcluster.TelemetryAggregator(
+            interval=0.1, registry=tmetrics_mod.Registry()
+        )
+        agg.set_peers([(w[2], w[3]) for w in workers])
+        try:
+            agg.scrape_once()
+            doc = agg.cluster_links()
+            role = doc["ring"]["role"]
+            assert role[labels[0]] == {
+                "level": "inter", "role": "head", "group": 0}
+            assert role[labels[1]]["role"] == "demoted"
+            out = render_links({
+                "peers": labels, "edges": {},
+                "ring": doc["ring"],
+            })
+            assert "hierarchy:" in out
+            hier = next(l for l in out.splitlines() if "hierarchy" in l)
+            assert "{[0],[1]▽|h[0]}" in hier
+            assert "{[2]|h[2]}" in hier
+            assert "▽ demoted" in hier
+        finally:
+            agg.stop()
+    finally:
+        for _, server, _, _ in workers:
+            try:
+                server.stop()
+            except Exception:  # noqa: BLE001 - teardown best effort
+                pass
+
+
+def test_info_links_all_flat_roles_render_no_hierarchy():
+    from kungfu_tpu.info.__main__ import render_links
+
+    peers = ["a:1", "b:2"]
+    doc = {
+        "peers": peers, "edges": {},
+        "ring": {"order": None, "position": {}, "next": {},
+                 "role": {p: {"level": "flat", "role": "member",
+                              "group": 0} for p in peers}},
+    }
+    assert "hierarchy:" not in render_links(doc)
+
+
+def test_info_decisions_names_demote_and_promote_records():
+    from kungfu_tpu.telemetry.decisions import render_decisions
+
+    doc = {"decisions": [
+        {"kind": "peer_demoted", "peer": "a:1", "epoch": 2,
+         "trigger": "straggler_patience", "predicted_gain": 1.3,
+         "status": "closed", "verdict": "delivered",
+         "detail": {"demoted_rank": "3"}, "wall_time": 0.0},
+        {"kind": "peer_promoted", "peer": "a:1", "epoch": 3,
+         "trigger": "straggler_recovered", "predicted_gain": 1.0,
+         "status": "open", "detail": {"promoted_rank": "3"},
+         "wall_time": 1.0},
+    ]}
+    out = render_decisions(doc)
+    assert "peer_demoted" in out and "[straggler_patience]" in out
+    assert "peer_promoted" in out and "[straggler_recovered]" in out
+    assert "demoted_rank=3" in out
+
+
+def test_demote_patience_knob_in_engine_consensus(clusters, monkeypatch):
+    """ISSUE 19 satellite: the new strict knob rides the engine-knob
+    consensus (the KF701 contract) and `hier` is an accepted
+    KF_CONFIG_REPLAN choice."""
+    from kungfu_tpu import knobs as kknobs
+
+    monkeypatch.setenv("KF_CONFIG_REPLAN", "hier")
+    assert kknobs.get("KF_CONFIG_REPLAN") == "hier"
+    cluster = clusters(2)
+    sessions = _sessions(cluster)
+    assert any(
+        k == "KF_REPLAN_DEMOTE_PATIENCE"
+        for k, _ in sessions[0].engine_knobs()
+    )
+    sessions[1].demote_patience = 99  # diverge one peer's resolved value
+    errs = {}
+
+    def run(r, sess):
+        try:
+            sess.check_knob_consensus()
+        except RuntimeError as e:
+            errs[r] = str(e)
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+    assert set(errs) == {0, 1}
+    assert all("KF_REPLAN_DEMOTE_PATIENCE" in m for m in errs.values())
+
+
+def test_hier_digest_under_row_sampled_matrices(clusters):
+    """ISSUE 19 satellite: under the sampled matrix (PR 18) peers can
+    hold rows of different ages. Decayed rows change the derived
+    HierPlan BYTES (the digest the vote walks) — so the staleness gate
+    must withhold the vote, and if a divergent plan ever reaches
+    adoption anyway, the digest raises a NAMED error on every peer
+    rather than hanging a later walk."""
+    hosts = [[0, 1, 2, 3], [4, 5, 6, 7]]
+    m = _dcn_matrix(8, hosts)
+    m[3, 4:8] = 9.0
+    fresh = rp.derive_hier_plan(m, hosts=hosts)
+    # a peer whose row 3 decayed (sampled rotation skipped it) elects a
+    # different head: same code, different bytes
+    stale = m.copy()
+    stale[3, 4:8] = 5.0
+    other = rp.derive_hier_plan(stale, hosts=hosts)
+    assert fresh is not None and other is not None
+    assert fresh.to_bytes() != other.to_bytes()
+    assert fresh.heads != other.heads
+    # identical bytes in → identical bytes out, always
+    assert rp.derive_hier_plan(m.copy(), hosts=hosts).to_bytes() \
+        == fresh.to_bytes()
+
+    # live: two peers adopting divergent HierPlans get the named error
+    cluster = clusters(2)
+    sessions = _sessions(cluster)
+    for s in sessions:
+        s.replan_mode = "hier"
+    errs = {}
+
+    def run(r, sess):
+        plan = rp.HierPlan(
+            groups=((0,), (1,)), heads=(0, 1),
+            gain=1.5 + 0.25 * r,  # gain rides the canonical bytes
+        )
+        try:
+            sess.adopt_replan(plan)
+        except RuntimeError as e:
+            errs[r] = str(e)
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)],
+                join=60)
+    assert set(errs) == {0, 1}
+    for msg in errs.values():
+        assert "re-plan diverged" in msg
+    assert all(s.hier_plan() is None for s in sessions)
+
+
+def test_replan_policy_withholds_hier_vote_on_stale_rows():
+    """The stale-row gate applies unchanged in hier mode: the vote is
+    withheld (never divergent) and the lockstep demote round still
+    runs so peers with fresh data stay in sync."""
+    from kungfu_tpu.policy import PolicyContext, ReplanPolicy
+
+    class Sess:
+        size = 4
+        replan_mode = "hier"
+
+        def __init__(self):
+            self.wants = []
+            self.demote_rounds = 0
+
+        def check_replan(self, want=True, min_gain=1.05, tag=""):
+            self.wants.append(bool(want))
+            return None
+
+        def demoted_peers(self):
+            return ()
+
+        def check_demote(self, demote=None, promote=None, tag=""):
+            self.demote_rounds += 1
+            return None
+
+    sess = Sess()
+    pol = ReplanPolicy(interval_steps=1, patience=1,
+                       session_supplier=lambda: sess,
+                       max_row_age_s=10.0)
+    ctx = PolicyContext(batch_size=1)
+    ctx.metrics["step/critical_edge"] = "b:2"
+    ctx.metrics["links/oldest_row_age_s"] = 99.0
+    ctx.step = 1
+    pol.after_step(ctx)
+    assert sess.wants == [False]
+    assert ctx.metrics["replan/vote_withheld_stale_links"] == 99.0
+    assert sess.demote_rounds == 1  # the lockstep round still ran

@@ -63,7 +63,12 @@ def make_peer(port: int):
     return pid, server, client, collective, queue, store, p2p
 
 
-_next_port = iter(range(41001, 42000))
+# Below the kernel ephemeral range (net.ipv4.ip_local_port_range,
+# 32768+): the in-process k=32/k=256 harnesses elsewhere in the suite
+# churn thousands of outbound connections whose kernel-assigned SOURCE
+# ports would otherwise collide with these fixed binds (SO_REUSEADDR
+# covers TIME_WAIT, not an established connection's local port).
+_next_port = iter(range(21001, 22000))
 
 
 @pytest.fixture
