@@ -77,6 +77,39 @@ try:
 except AttributeError:
     has_wire_codec = False
 
+# Same guard again for the block-scaled int8/int4 codec (per-block pow2
+# absmax scales + packed low-bit payload, f32 accumulation): a stale .so
+# degrades to the numpy quantizer in ops.py.
+try:
+    _lib.kf_encode_wire_q.restype = ctypes.c_int
+    _lib.kf_encode_wire_q.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    _lib.kf_decode_wire_q.restype = ctypes.c_int
+    _lib.kf_decode_wire_q.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    _lib.kf_decode_accumulate_q.restype = ctypes.c_int
+    _lib.kf_decode_accumulate_q.argtypes = [
+        ctypes.c_void_p,
+        ctypes.c_void_p,
+        ctypes.c_int64,
+        ctypes.c_int32,
+        ctypes.c_int32,
+        ctypes.c_int32,
+    ]
+    has_wire_codec_q = True
+except AttributeError:
+    has_wire_codec_q = False
+
 
 def supported(dtype) -> bool:
     try:
@@ -147,3 +180,38 @@ def decode_accumulate(acc: np.ndarray, src: np.ndarray, wire: int, op: int) -> N
     rc = _lib.kf_decode_accumulate(pa, ps, acc.size, int(wire), int(op))
     if rc != 0:
         raise ValueError(f"native decode_accumulate unsupported: wire={wire}, op={op}")
+
+
+def encode_wire_q(dst: np.ndarray, src: np.ndarray, bits: int, block: int) -> None:
+    """dst_u8 = [block scales f32][packed int8/int4 payload] of src_f32."""
+    pd, ps = _ptr(dst), _ptr(src)
+    if pd is None or ps is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_encode_wire_q(pd, ps, src.size, int(bits), int(block))
+    if rc != 0:
+        raise ValueError(f"native encode_wire_q unsupported: bits={bits}, block={block}")
+
+
+def decode_wire_q(dst: np.ndarray, src: np.ndarray, bits: int, block: int) -> None:
+    """dst_f32 = decode(src_u8) from the block-scaled low-bit layout.
+    Element count comes from dst (the payload length is derived)."""
+    pd, ps = _ptr(dst), _ptr(src)
+    if pd is None or ps is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_decode_wire_q(pd, ps, dst.size, int(bits), int(block))
+    if rc != 0:
+        raise ValueError(f"native decode_wire_q unsupported: bits={bits}, block={block}")
+
+
+def decode_accumulate_q(acc: np.ndarray, src: np.ndarray, bits: int, block: int,
+                        op: int) -> None:
+    """acc_f32 = acc_f32 `op` decode(src_u8) — fused block-scaled decode +
+    reduce in one pass (native/reduce.cpp kf_decode_accumulate_q)."""
+    pa, ps = _ptr(acc), _ptr(src)
+    if pa is None or ps is None:
+        raise ValueError("non-contiguous buffer")
+    rc = _lib.kf_decode_accumulate_q(pa, ps, acc.size, int(bits), int(block), int(op))
+    if rc != 0:
+        raise ValueError(
+            f"native decode_accumulate_q unsupported: bits={bits}, block={block}, op={op}"
+        )

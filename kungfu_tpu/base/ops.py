@@ -193,6 +193,210 @@ def decode_accumulate(
     _NUMPY_OPS[op](seg, tmp, out=seg)
 
 
+# ---------------------------------------------------------------------------
+# block-scaled int8/int4 wire codec (ISSUE 20)
+# ---------------------------------------------------------------------------
+#
+# Each `block`-element run of the f32 payload is scaled by one f32
+# power-of-two s = 2^ceil(log2(absmax / Qmax)) and quantized to
+# q = clamp(rne(x / s), -Qmax, Qmax) with Qmax = 127 (int8) / 7 (int4) —
+# the encoded segment is [ceil(n/block) f32 scales][packed payload].
+# The pow2 scale makes decode (s * q) EXACT in f32 and re-encoding a
+# decoded block reproduce the identical bytes (idempotent re-encode),
+# which is what lets graph-walk relays and the bcast-root roundtrip keep
+# cross-peer bit-identity, matching the 2-byte codec's contract.
+# Accumulation stays f32 (fused decode+reduce), and the collective layer
+# adds error-feedback residuals so per-step rounding telescopes instead
+# of compounding. Native kernels behind `has_wire_codec_q`; the numpy
+# fallback below bit-matches them (np.frexp/np.ldexp/np.rint mirror
+# frexpf/ldexpf/rintf — both sides round to nearest-even).
+
+
+class QWire:
+    """Wire spec for the block-scaled low-bit codec.
+
+    Stands in for a ``DType`` in the walk layer's ``wire`` parameter:
+    ``.name`` lowercases to the ``codec`` metric label ("int8"/"int4")
+    exactly like ``DType.BF16.name``; payload sizes come from
+    :func:`wire_nbytes`, not ``2 * count``.
+    """
+
+    __slots__ = ("bits", "block", "name")
+
+    def __init__(self, bits: int, block: int = 16):
+        if bits not in (8, 4):
+            raise ValueError(f"unsupported wire bits: {bits!r}")
+        if block < 1:
+            raise ValueError(f"wire block must be >= 1: {block!r}")
+        self.bits = int(bits)
+        self.block = int(block)
+        self.name = f"INT{bits}"
+
+    def __repr__(self) -> str:
+        return f"QWire(bits={self.bits}, block={self.block})"
+
+    def __eq__(self, other) -> bool:
+        return (
+            isinstance(other, QWire)
+            and self.bits == other.bits
+            and self.block == other.block
+        )
+
+    def __hash__(self) -> int:
+        return hash((QWire, self.bits, self.block))
+
+
+def wire_nbytes_q(count: int, bits: int, block: int) -> int:
+    """Encoded byte length of `count` f32 elements under the block-scaled
+    layout: 4 bytes of scale per block + 1 byte (int8) or a nibble
+    (int4, odd counts round up) per element."""
+    nb = (count + block - 1) // block
+    return 4 * nb + (count if bits == 8 else (count + 1) // 2)
+
+
+def wire_nbytes(count: int, wire) -> int:
+    """Encoded byte length of `count` f32 elements under any wire spec —
+    2 bytes/element for the 16-bit dtypes, the block-scaled layout for
+    :class:`QWire`."""
+    if isinstance(wire, QWire):
+        return wire_nbytes_q(count, wire.bits, wire.block)
+    return 2 * count
+
+
+def _wire_native_q():
+    native = _load_native()
+    if native and getattr(native, "has_wire_codec_q", False):
+        return native
+    return None
+
+
+def _q_scales(src: np.ndarray, bits: int, block: int) -> np.ndarray:
+    """Per-block pow2 scales, bit-matching the native q_block_scale."""
+    n = src.size
+    nb = (n + block - 1) // block
+    padded = np.zeros(nb * block, np.float32)
+    padded[:n] = src
+    amax = np.max(np.abs(padded.reshape(nb, block)), axis=1)
+    qmax = np.float32(127.0 if bits == 8 else 7.0)
+    with np.errstate(divide="ignore", invalid="ignore"):
+        t = amax / qmax
+        m, e = np.frexp(t)
+        s = np.ldexp(np.float32(1.0), np.where(m == np.float32(0.5), e - 1, e))
+    return np.where(amax == 0.0, np.float32(0.0), s.astype(np.float32))
+
+
+def encode_wire_q(dst: np.ndarray, src: np.ndarray, wire: QWire) -> None:
+    """dst_u8 = [block scales][packed payload] of src_f32. dst must hold
+    exactly ``wire_nbytes(src.size, wire)`` bytes."""
+    n = src.size
+    nb = (n + wire.block - 1) // wire.block
+    if dst.size != wire_nbytes(n, wire):
+        raise ValueError(
+            f"encoded buffer mismatch: {dst.size} bytes for {n} elements "
+            f"of {wire!r} (want {wire_nbytes(n, wire)})"
+        )
+    native = _wire_native_q()
+    if native is not None:
+        native.encode_wire_q(dst, src, wire.bits, wire.block)
+        return
+    s = _q_scales(src, wire.bits, wire.block)
+    dst[: 4 * nb] = np.frombuffer(s.astype("<f4").tobytes(), np.uint8)
+    qmax = np.float32(127.0 if wire.bits == 8 else 7.0)
+    with np.errstate(divide="ignore"):
+        inv = np.where(s == 0.0, np.float32(0.0), np.float32(1.0) / s)
+    padded = np.zeros(nb * wire.block, np.float32)
+    padded[:n] = src
+    q = np.clip(
+        np.rint(padded.reshape(nb, wire.block) * inv[:, None]), -qmax, qmax
+    ).astype(np.int8).reshape(-1)[:n]
+    if wire.bits == 8:
+        dst[4 * nb:] = q.view(np.uint8)
+        return
+    if n & 1:
+        q = np.concatenate([q, np.zeros(1, np.int8)])
+    nibs = q.view(np.uint8) & np.uint8(0xF)
+    dst[4 * nb:] = nibs[0::2] | (nibs[1::2] << np.uint8(4))
+
+
+def decode_wire_q(dst: np.ndarray, src: np.ndarray, wire: QWire) -> None:
+    """dst_f32 = decode(src_u8); element count comes from dst. Exact:
+    every decoded value is a pow2 scale times a small integer."""
+    n = dst.size
+    nb = (n + wire.block - 1) // wire.block
+    if src.size != wire_nbytes(n, wire):
+        raise ValueError(
+            f"encoded payload mismatch: {src.size} bytes for {n} elements "
+            f"of {wire!r} (want {wire_nbytes(n, wire)})"
+        )
+    native = _wire_native_q()
+    if native is not None:
+        native.decode_wire_q(dst, src, wire.bits, wire.block)
+        return
+    s = np.frombuffer(src[: 4 * nb].tobytes(), "<f4").astype(np.float32)
+    if wire.bits == 8:
+        q = src[4 * nb:].view(np.int8).astype(np.float32)
+    else:
+        packed = src[4 * nb:]
+        nibs = np.empty(2 * packed.size, np.uint8)
+        nibs[0::2] = packed & np.uint8(0xF)
+        nibs[1::2] = packed >> np.uint8(4)
+        q = nibs[:n].astype(np.int16)
+        q = np.where(q >= 8, q - 16, q).astype(np.float32)
+    dst[:] = np.repeat(s, wire.block)[:n] * q
+
+
+def decode_accumulate_q(
+    acc: np.ndarray, begin: int, end: int, src: np.ndarray,
+    wire: QWire, op: ReduceOp,
+) -> None:
+    """acc[begin:end] = acc[begin:end] `op` decode(src), in f32 — the
+    fused per-step hot path of the quantized ring walk."""
+    if not 0 <= begin <= end <= acc.size:
+        raise ValueError(
+            f"segment [{begin}:{end}) outside buffer of {acc.size} elements"
+        )
+    count = end - begin
+    if src.size != wire_nbytes(count, wire):
+        raise ValueError(
+            f"encoded payload mismatch: {src.size} bytes for segment "
+            f"[{begin}:{end}) of {wire!r} (want {wire_nbytes(count, wire)})"
+        )
+    seg = acc[begin:end]
+    native = _wire_native_q()
+    if native is not None:
+        native.decode_accumulate_q(seg, src, wire.bits, wire.block, int(op))
+        return
+    tmp = np.empty(count, np.float32)
+    decode_wire_q(tmp, src, wire)
+    _NUMPY_OPS[op](seg, tmp, out=seg)
+
+
+def encode_wire_any(dst: np.ndarray, src: np.ndarray, wire) -> None:
+    """Encode under any wire spec (DType or QWire)."""
+    if isinstance(wire, QWire):
+        encode_wire_q(dst, src, wire)
+    else:
+        encode_wire(dst, src, wire)
+
+
+def decode_wire_any(dst: np.ndarray, src: np.ndarray, wire) -> None:
+    """Decode under any wire spec (DType or QWire)."""
+    if isinstance(wire, QWire):
+        decode_wire_q(dst, src, wire)
+    else:
+        decode_wire(dst, src, wire)
+
+
+def decode_accumulate_any(
+    acc: np.ndarray, begin: int, end: int, src: np.ndarray, wire, op: ReduceOp,
+) -> None:
+    """Fused decode+reduce under any wire spec (DType or QWire)."""
+    if isinstance(wire, QWire):
+        decode_accumulate_q(acc, begin, end, src, wire, op)
+    else:
+        decode_accumulate(acc, begin, end, src, wire, op)
+
+
 def transform_n(dst: np.ndarray, srcs, op: ReduceOp) -> None:
     """dst = srcs[0] op srcs[1] op ... op srcs[k-1] in ONE memory pass
     (native kernel); dst must not alias any src. The k-1 pairwise

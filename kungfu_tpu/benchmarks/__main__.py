@@ -1208,10 +1208,12 @@ def main() -> None:
         "runs the same argv so the override is cluster-agreed)",
     )
     p.add_argument(
-        "--wire", choices=["off", "bf16", "f16", "auto"], default="",
+        "--wire", choices=["off", "bf16", "f16", "auto", "int8", "int4"],
+        default="",
         help="HOST engine A/B: wire codec for f32 payloads (sets "
         "KF_CONFIG_WIRE before the session comes up; cluster-agreed the "
-        "same way as --algo)",
+        "same way as --algo). int8/int4 are the block-scaled quantized "
+        "codecs (ISSUE 20) with error-feedback on the segmented paths",
     )
     p.add_argument(
         "--wire-ab", action="store_true",

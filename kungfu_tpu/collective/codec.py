@@ -17,8 +17,9 @@ import numpy as np
 
 from kungfu_tpu import knobs
 from kungfu_tpu.base.dtype import DType
-from kungfu_tpu.base.ops import decode_wire
+from kungfu_tpu.base.ops import QWire, decode_wire
 from kungfu_tpu.base.workspace import Workspace
+from kungfu_tpu.telemetry import log
 from kungfu_tpu.utils.pool import get_buffer_pool
 
 # Wire codec (ISSUE 5 tentpole): f32 allreduce payloads travel the
@@ -30,9 +31,16 @@ from kungfu_tpu.utils.pool import get_buffer_pool
 # format: f32-identical exponent range, so no overflow surprises); it is
 # a distinct mode so later heuristics (payload- or link-aware) can slot
 # in without an env change.
-WIRE_MODES = ("off", "bf16", "f16", "auto")
+#
+# ISSUE 20 grows the table with block-scaled int8/int4 (one f32 pow2
+# absmax scale per KF_WIRE_BLOCK elements, error-feedback residuals on
+# the segmented paths so per-step rounding telescopes). Same consensus
+# discipline: the mode AND the block size decide message byte counts.
+WIRE_MODES = ("off", "bf16", "f16", "auto", "int8", "int4")
 
 WIRE_DTYPE = {"bf16": DType.BF16, "f16": DType.F16, "auto": DType.BF16}
+
+_WIRE_Q_BITS = {"int8": 8, "int4": 4}
 
 
 def wire_override() -> str:
@@ -86,6 +94,11 @@ class WireCodec:
     # Cluster-agreed like SEGMENT_MIN_BYTES (it decides message sizes).
     WIRE_MIN_BYTES = int(knobs.get("KF_CONFIG_WIRE_MIN_BYTES"))
 
+    # Elements per absmax scale block of the quantized codec. Cluster-
+    # agreed (KF701: in engine_knobs AND consensus=True) — it decides
+    # the byte length of every int8/int4 message.
+    WIRE_BLOCK = int(knobs.get("KF_WIRE_BLOCK"))
+
     def _active_wire_mode(self) -> str:
         """The RUNNING codec mode: the active adaptive candidate's wire
         member, or the configured mode under a set_tree override (an
@@ -93,6 +106,11 @@ class WireCodec:
         if self._tree_override:
             return self.wire_mode
         return self._candidates[self.adaptive.active][1]
+
+    def active_wire_mode(self) -> str:
+        """Public accessor of the RUNNING codec mode — what `info links`
+        renders and the precision policy compares its target against."""
+        return self._active_wire_mode()
 
     def _codec_bypass(self, reason: str, w: Workspace) -> None:
         """Audit (once per (reason, dtype) per session epoch) that a
@@ -113,16 +131,28 @@ class WireCodec:
             nbytes=int(w.recv.nbytes),
         )
 
-    def _wire_codec_for(self, w: Workspace) -> Optional[DType]:
-        """Codec decision for one allreduce workspace, or None (raw).
+    def _wire_codec_for(self, w: Workspace):
+        """Codec decision for one allreduce workspace: a ``DType``
+        (2-byte codec), a :class:`QWire` (block-scaled int8/int4), or
+        None (raw).
 
         MUST depend only on cluster-agreed inputs — the resolved wire
         mode (env + lockstep adaptive votes) and workspace properties
         identical on every peer — because it decides the byte count of
         every message in the walk. Non-f32 payloads (consensus lanes,
         int gradients) and sub-WIRE_MIN_BYTES residuals bypass with an
-        audit event, never an error."""
+        audit event, never an error. An UNKNOWN mode string on this
+        lenient path (the strict knob parser can't be the only guard:
+        ``wire_mode`` and the candidate table are plain session state a
+        version-skewed vote or embedder could corrupt) warns loudly and
+        runs exact — never silently quantize."""
         mode = self._active_wire_mode()
+        if mode != self._ef_mode:
+            # any precision flip (adaptive vote, candidate toggle,
+            # rollback) invalidates carried error-feedback residuals:
+            # they measure the OLD codec's rounding
+            self._flush_residuals(f"wire mode {self._ef_mode!r} -> {mode!r}")
+            self._ef_mode = mode
         if mode == "off":
             return None
         if w.send.dtype != np.float32:
@@ -131,4 +161,54 @@ class WireCodec:
         if w.recv.nbytes < self.WIRE_MIN_BYTES:
             self._codec_bypass("below_min_bytes", w)
             return None
-        return WIRE_DTYPE[mode]
+        bits = _WIRE_Q_BITS.get(mode)
+        if bits is not None:
+            return QWire(bits, self.WIRE_BLOCK)
+        codec = WIRE_DTYPE.get(mode)
+        if codec is None:
+            if mode not in self._unknown_wire_warned:
+                self._unknown_wire_warned.add(mode)
+                log.warning(
+                    "wire codec: unknown mode %r reached the running "
+                    "session — running EXACT (no compression). Valid "
+                    "modes: %s", mode, ", ".join(WIRE_MODES),
+                )
+            self._codec_bypass("unknown_mode", w)
+            return None
+        return codec
+
+    # --- error-feedback residual store (quantized codec only) ----------
+    #
+    # One full-size f32 residual per workspace name: the un-transmitted
+    # remainder of the last quantized send, added back into the next
+    # send so rounding telescopes (sum of decodes = sum of inputs +
+    # r_first - r_last) instead of compounding. Lifecycle: lazily
+    # zeroed; FLUSHED on any wire-mode change and on re-plan adoption
+    # (segment ownership moved — a residual computed against the old
+    # bounds would correct the wrong slice); dies with the session on
+    # elastic resize. ZeRO's per-shard residuals live in zero.py but
+    # register a flush listener here so every flush reaches them too.
+
+    def _ef_residual(self, key: str, size: int) -> np.ndarray:
+        r = self._ef_store.get(key)
+        if r is None or r.size != size:
+            r = np.zeros(size, np.float32)
+            self._ef_store[key] = r
+        return r
+
+    def _flush_residuals(self, reason: str) -> None:
+        if self._ef_store:
+            log.debug("wire codec: flushing %d error-feedback residuals (%s)",
+                      len(self._ef_store), reason)
+        self._ef_store.clear()
+        for cb in tuple(self._ef_flush_listeners):
+            try:
+                cb(reason)
+            except Exception as e:  # noqa: BLE001 - flush must reach the rest
+                log.warning("wire codec: residual flush listener failed: %s", e)
+
+    def add_ef_flush_listener(self, cb) -> None:
+        """Register `cb(reason)` to run on every residual flush — the
+        hook ZeRO uses to reset its per-shard residuals in lockstep
+        with the session store."""
+        self._ef_flush_listeners.append(cb)

@@ -45,7 +45,7 @@ from kungfu_tpu.base.strategy import Strategy
 from kungfu_tpu.base.workspace import Workspace
 from kungfu_tpu.collective import strategies as st
 from kungfu_tpu.collective.adaptive import AdaptiveState
-from kungfu_tpu.collective.codec import WireCodec, wire_override
+from kungfu_tpu.collective.codec import WIRE_MODES, WireCodec, wire_override
 from kungfu_tpu.collective.pipeline import GroupFusion
 from kungfu_tpu.collective.profiler import (  # noqa: F401 - back-compat re-exports
     SpanSampler,
@@ -260,6 +260,18 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         # audit dedup for codec bypasses: one event per (reason, dtype)
         # per session epoch, so consensus lanes don't flood the audit log
         self._codec_bypass_seen: set = set()
+        # error-feedback residual store of the quantized wire codec
+        # (ISSUE 20): per-workspace f32 remainders, flushed on wire-mode
+        # changes and re-plan adoption (see WireCodec._flush_residuals);
+        # dies with the session on elastic resize — deterministically
+        # zero on every peer of the new epoch
+        self._ef_store: Dict[str, np.ndarray] = {}
+        self._ef_mode: Optional[str] = None
+        self._ef_flush_listeners: List[object] = []
+        self._unknown_wire_warned: set = set()
+        # monotone count of adopted precision flips: names the vote
+        # workspaces and stamps the consensus digest of each switch
+        self._precision_flips = 0
         # link plane + walk profiler (ISSUE 6): the local link table
         # supplies per-destination bandwidth estimates the profiler
         # scores walks against; the sampler thins per-step spans
@@ -305,9 +317,20 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
                 "(level, role), value = host-group index)",
                 ("level", "role"),
             )
+            # active wire precision (ISSUE 20): the RUNNING codec mode
+            # (config + lockstep precision/interference votes), exported
+            # so `info links` can render what payloads actually cross
+            # the transport as
+            self._wire_mode_g = tmetrics.gauge(
+                "kungfu_collective_wire_mode",
+                "Active wire-codec mode of this peer's collective "
+                "session (child per mode, value 1 on the running one)",
+                ("mode",),
+            )
         else:
             self._ring_pos_g = self._ring_next_g = self._replans_ctr = None
             self._ring_role_g = None
+            self._wire_mode_g = None
         self._publish_ring_metrics()
         # collective-order sentinel (ISSUE 12): with the debug knob set,
         # protowatch wraps this instance's public entry points at bind
@@ -470,6 +493,7 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         name: str,
         cancel: Optional[threading.Event] = None,
         allow_wire: bool = True,
+        ef: Optional[np.ndarray] = None,
     ) -> None:
         """Standalone segment all-gather (ISSUE 11): the caller placed
         this rank's shard into ``full``'s owned segment
@@ -483,12 +507,20 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         bytes per peer — with each segment quantized exactly once by its
         owner and decoded once per peer at walk end, so every peer
         (owner included) lands on bit-identical values; see
-        docs/collectives.md for the error model."""
+        docs/collectives.md for the error model.
+
+        ``ef`` (quantized modes only): a caller-owned f32 error-feedback
+        residual sized to THIS RANK's owned segment — the send quantizes
+        shard+residual and the new residual is written back in place.
+        Callers whose shards outlive the walk name (ZeRO's round-stamped
+        gathers) pass their per-shard buffer here instead of relying on
+        the session's name-keyed store."""
         ws = Workspace(send=full, recv=full, op=ReduceOp.SUM, name=name)
         wire = self._wire_codec_for(ws) if allow_wire else None
         with self._collected("all_gather", full.nbytes):
             with stall_detect(f"all_gather({name})"):
-                self._run_segmented(ws, cancel=cancel, wire=wire, phase="ag")
+                self._run_segmented(ws, cancel=cancel, wire=wire, phase="ag",
+                                    ef_owned=ef)
 
     def monitored_all_reduce(self, w: Workspace) -> None:
         """AllReduce + throughput accounting for the ACTIVE strategy
@@ -546,6 +578,7 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             f":switch:{self.adaptive.switch_count}",
         ):
             raise RuntimeError("strategy switch diverged across peers")
+        self._publish_wire_mode()
         from kungfu_tpu.telemetry import audit as _audit
 
         _audit.record_event(
@@ -573,6 +606,87 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             new=f"{new_strategy.name}/{new_wire}",
         )
         return True
+
+    def check_precision(
+        self,
+        proposal: Optional[str] = None,
+        trigger: str = "noise_scale",
+        signals: Optional[dict] = None,
+        vote_tag: str = "",
+    ) -> Optional[str]:
+        """Majority vote on the wire PRECISION of the active candidate
+        (ISSUE 20): every peer calls in lockstep at a step boundary with
+        its locally preferred mode (``proposal``; None votes to keep the
+        current one), ballots are one-hot over :data:`WIRE_MODES`, and a
+        strict cluster majority for a different mode flips the active
+        candidate's wire member on EVERY peer — same graphs, new codec.
+        Returns the new mode, or None when nothing changed.
+
+        The flip is digest-checked like a strategy switch (a codec split
+        would desync every message size in the walk), flushes the
+        error-feedback residual store (residuals measure the OLD codec's
+        rounding), and opens a ``precision_switch`` decision-ledger
+        record so a throughput- or accuracy-hostile downshift closes
+        ``regressed`` and the precision policy votes itself back."""
+        if proposal is not None and proposal not in WIRE_MODES:
+            raise ValueError(
+                f"check_precision: unknown wire mode {proposal!r}; "
+                f"expected one of {', '.join(WIRE_MODES)}"
+            )
+        old_mode = self._active_wire_mode()
+        want = proposal if proposal is not None else old_mode
+        votes_in = np.zeros(len(WIRE_MODES), np.int32)
+        votes_in[WIRE_MODES.index(want)] = 1
+        votes_out = np.zeros(len(WIRE_MODES), np.int32)
+        self.all_reduce(
+            Workspace(votes_in, votes_out, ReduceOp.SUM,
+                      f"kungfu::precision:{self._precision_flips}{vote_tag}")
+        )
+        winner = None
+        for i, mode in enumerate(WIRE_MODES):
+            if mode != old_mode and int(votes_out[i]) * 2 > self.size:
+                winner = mode
+                break
+        if winner is None:
+            return None
+        self._precision_flips += 1
+        if self._tree_override:
+            self.wire_mode = winner
+        else:
+            strategy = self._candidates[self.adaptive.active][0]
+            self._candidates[self.adaptive.active] = (strategy, winner)
+        # safety: every peer must now frame messages in the same codec
+        if not self.bytes_consensus(
+            winner.encode(),
+            f":precision:{self._precision_flips}",
+        ):
+            raise RuntimeError("precision switch diverged across peers")
+        self._flush_residuals(f"precision vote {old_mode!r} -> {winner!r}")
+        self._publish_wire_mode()
+        from kungfu_tpu.telemetry import audit as _audit
+
+        _audit.record_event(
+            "precision_switch",
+            peer=str(self.self_id),
+            trigger=trigger,
+            old_wire=old_mode,
+            new_wire=winner,
+            flip_count=self._precision_flips,
+        )
+        from kungfu_tpu.telemetry import decisions as _decisions
+
+        _decisions.open_decision(
+            "precision_switch",
+            peer=str(self.self_id),
+            epoch=self.cluster_version,
+            trigger=trigger,
+            signals=dict(signals or {},
+                         votes=int(votes_out[WIRE_MODES.index(winner)]),
+                         size=self.size),
+            old=old_mode,
+            new=winner,
+        )
+        return winner
 
     def active_strategy(self) -> Optional[Strategy]:
         """The running candidate strategy, or None when an explicit
@@ -960,6 +1074,9 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
         self._demoted = hier.demoted if hier is not None else ()
         for listener, token in tokens:
             listener.post_replan(token)
+        # error-feedback residuals index the OLD plan's segment bounds;
+        # under the new ownership they would correct the wrong slices
+        self._flush_residuals("replan adopted: segment ownership moved")
         self._publish_ring_metrics()
         if self._replans_ctr is not None:
             self._replans_ctr.inc()
@@ -1051,6 +1168,15 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
                 else:
                     level, role = "intra", "member"
                 self._ring_role_g.labels(level, role).set(gi)
+        self._publish_wire_mode()
+
+    def _publish_wire_mode(self) -> None:
+        """Refresh the active-precision gauge; children are rebuilt so a
+        precision flip never leaves the OLD mode frozen at 1."""
+        if self._wire_mode_g is None:
+            return
+        self._wire_mode_g.clear_children()
+        self._wire_mode_g.labels(self._active_wire_mode()).set(1)
 
     def cross_all_reduce(self, w: Workspace) -> None:
         """AllReduce across host masters only (hierarchical path). While
@@ -1199,6 +1325,7 @@ class HostSession(WalkEngine, WireCodec, GroupFusion):
             ("KF_CONFIG_GROUP_FUSE_MIN", str(self.FUSE_MIN_TENSORS)),
             ("KF_CONFIG_WIRE", self.wire_mode),
             ("KF_CONFIG_WIRE_MIN_BYTES", str(self.WIRE_MIN_BYTES)),
+            ("KF_WIRE_BLOCK", str(self.WIRE_BLOCK)),
             ("KF_CONFIG_ASYNC", self.async_mode),
             ("KF_CONFIG_ZERO", self.zero_mode),
             ("KF_CONFIG_REPLAN", self.replan_mode),

@@ -27,14 +27,16 @@ import numpy as np
 from kungfu_tpu import knobs
 from kungfu_tpu.base.dtype import DType
 from kungfu_tpu.base.ops import (
+    QWire,
     copy_segment,
-    decode_accumulate,
-    decode_wire,
-    encode_wire,
+    decode_accumulate_any,
+    decode_wire_any,
+    encode_wire_any,
     reduce_inplace,
     reduce_segment,
     transform_n,
 )
+from kungfu_tpu.base.ops import wire_nbytes as _wire_payload_nbytes
 from kungfu_tpu.base.strategy import Strategy
 from kungfu_tpu.base.workspace import Workspace, even_partition
 from kungfu_tpu.collective import strategies as st
@@ -276,9 +278,10 @@ class WalkEngine:
         w: Workspace,
         ranks: Optional[Sequence[int]] = None,
         cancel: Optional[threading.Event] = None,
-        wire: Optional[DType] = None,
+        wire=None,
         defer_decode: bool = False,
         phase: str = "all",
+        ef_owned: Optional[np.ndarray] = None,
     ) -> Optional[DeferredDecode]:
         """Bandwidth-optimal segmented walk: a (k-1)-step reduce-scatter
         over contiguous segments followed by a (k-1)-step all-gather
@@ -330,7 +333,17 @@ class WalkEngine:
           relays every segment around the ring, wire-encoded when `wire`
           is set (each segment quantized once by its owner, decoded once
           per peer at walk end — every peer, owner included, lands on
-          bit-identical values)."""
+          bit-identical values).
+
+        `wire` accepts a :class:`~kungfu_tpu.base.dtype.DType` (bf16/
+        f16) or a :class:`~kungfu_tpu.base.ops.QWire` (block-scaled
+        int8/int4). The quantized codec additionally carries
+        error-feedback residuals: full walks use the session store
+        (keyed by workspace name); the standalone ``"ag"`` phase takes
+        the caller's per-shard residual via `ef_owned` (sized to the
+        OWNED segment — ZeRO's weight leg). Quantized walks never defer
+        the walk-end decode (member bounds don't align with the
+        block-scaled layout), so `defer_decode` is ignored for them."""
         if phase not in ("all", "rs", "ag"):
             raise ValueError(f"unknown segmented phase: {phase!r}")
         if phase == "rs":
@@ -366,8 +379,14 @@ class WalkEngine:
         send_peer = self.peers[sched.send_peer]
         recv_peer = self.peers[sched.recv_peer]
         itemsize = acc.itemsize
-        wire_itemsize = 2 if wire is not None else itemsize
         codec_label = wire.name.lower() if wire is not None else "off"
+
+        def seg_wire_nbytes(count: int) -> int:
+            """Bytes segment `count` elements occupy on the wire."""
+            if wire is None:
+                return count * itemsize
+            return _wire_payload_nbytes(count, wire)
+
         bufpool = get_buffer_pool()
         deadline = time.monotonic() + self.timeout
         wire_bytes = 0
@@ -380,12 +399,57 @@ class WalkEngine:
         # all-gather wire buffer: segments stay encoded here from the
         # owner's single quantization until the walk-end decode. Leaked
         # (not pool-returned) on any error — the transport may still be
-        # mid-fill into a timed-out sink slice.
+        # mid-fill into a timed-out sink slice. 16-bit codecs index it
+        # by element (2 bytes each); the block-scaled quantizer's
+        # variable-length segments get per-segment byte offsets (scales
+        # + packed payload, blocks relative to each segment start — the
+        # segment's single owner encodes every one of its scale blocks).
         wirebuf: Optional[bytearray] = None
         wirearr: Optional[np.ndarray] = None
-        if wire is not None:
+        qoff: Optional[List[int]] = None
+        if isinstance(wire, QWire):
+            qoff = [0]
+            for b, e in bounds:
+                qoff.append(qoff[-1] + seg_wire_nbytes(e - b))
+            wirebuf = bufpool.get(qoff[-1])
+            wirearr = np.frombuffer(wirebuf, np.uint8, qoff[-1])
+        elif wire is not None:
             wirebuf = bufpool.get(acc.size * 2)
             wirearr = np.frombuffer(wirebuf, np.uint16, acc.size)
+
+        def ag_slice(seg: int) -> np.ndarray:
+            """The wire buffer slice holding segment `seg`'s encoding."""
+            b, e = bounds[seg]
+            if qoff is not None:
+                return wirearr[qoff[seg]:qoff[seg + 1]]
+            return wirearr[b:e]
+
+        # error feedback (quantized codec only): the un-transmitted
+        # remainder of each quantized send, added back into the next
+        # one. Full walks carry a session-store residual keyed by the
+        # workspace name (flushed on mode changes and re-plans, dead on
+        # resize); the standalone all-gather takes the caller's
+        # per-shard buffer (`ef_owned`, ZeRO's weight leg). RS sends and
+        # the AG seed touch DISJOINT slices (a peer never RS-sends the
+        # segment it ends up owning), so each element's residual is
+        # written at most once per walk — pool-thread encodes included.
+        ef_full: Optional[np.ndarray] = None
+        if isinstance(wire, QWire) and phase == "all":
+            ef_full = self._ef_residual(w.name, acc.size)
+
+        def encode_seg(payload: np.ndarray, sb: int, se: int,
+                       ef: Optional[np.ndarray]) -> None:
+            """Quantize acc[sb:se] into `payload`, folding the carried
+            residual in and banking the new remainder (EF). Exact for
+            the 16-bit codecs' callers too (ef is None there)."""
+            if ef is None:
+                encode_wire_any(payload, acc[sb:se], wire)
+                return
+            corrected = acc[sb:se] + ef
+            encode_wire_any(payload, corrected, wire)
+            decoded = np.empty(se - sb, np.float32)
+            decode_wire_any(decoded, payload, wire)
+            np.subtract(corrected, decoded, out=ef)
 
         def do_send(name: str, sb: int, se: int, buf) -> None:
             """Deadline-bounded send: a frozen successor (full shm ring
@@ -424,7 +488,7 @@ class WalkEngine:
             if errs:
                 raise errs[0]
 
-        def start_send_wire(name: str, sb: int, se: int, buf):
+        def start_send_wire(name: str, sb: int, se: int, buf, ef=None):
             """Async wire-mode send: encode (when `buf` is an f32 view)
             and transport copy run on the pool thread so they OVERLAP
             the blocking predecessor recv — the codec's encode would
@@ -432,22 +496,27 @@ class WalkEngine:
             a time-sliced multi-worker host punishes step after step.
             Safe because a step's send and recv segments are disjoint by
             schedule construction, so the thread reads acc[sb:se] (or a
-            wirearr slice) while the main thread fills a different
-            segment. Returns (done, errs) for finish_send; the encode
-            scratch is pool-returned by the thread itself (never while
-            anything can still read it)."""
+            wirearr slice) and writes the disjoint residual slice `ef`
+            while the main thread fills a different segment. Returns
+            (done, errs) for finish_send; the encode scratch is
+            pool-returned by the thread itself (never while anything can
+            still read it)."""
             done = threading.Event()
             errs: List[BaseException] = []
 
             def run() -> None:
                 try:
-                    if buf.dtype == np.uint16:
-                        payload = buf  # all-gather: already wire dtype
+                    if buf.dtype != np.float32:
+                        payload = buf  # all-gather: already wire-encoded
                         scratch = None
                     else:
-                        scratch = bufpool.get((se - sb) * 2)
-                        payload = np.frombuffer(scratch, np.uint16, se - sb)
-                        encode_wire(payload, buf, wire)
+                        nb = seg_wire_nbytes(se - sb)
+                        scratch = bufpool.get(nb)
+                        if qoff is not None:
+                            payload = np.frombuffer(scratch, np.uint8, nb)
+                        else:
+                            payload = np.frombuffer(scratch, np.uint16, se - sb)
+                        encode_seg(payload, sb, se, ef)
                     self.client.send(
                         send_peer, name, _buf(payload), ConnType.COLLECTIVE
                     )
@@ -476,11 +545,16 @@ class WalkEngine:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(f"segmented walk timed out: {name}")
-            recv_dtype = np.dtype(np.uint16) if wire is not None else acc.dtype
+            nb = seg_wire_nbytes(re_ - rb)
+            if qoff is not None:
+                recv_dtype, recv_count = np.dtype(np.uint8), nb
+            elif wire is not None:
+                recv_dtype, recv_count = np.dtype(np.uint16), re_ - rb
+            else:
+                recv_dtype, recv_count = acc.dtype, re_ - rb
             _t_recv = time.perf_counter()
             incoming, scratch, release = self._recv_collective(
-                recv_peer, name, (re_ - rb) * wire_itemsize, recv_dtype,
-                re_ - rb, remaining,
+                recv_peer, name, nb, recv_dtype, recv_count, remaining,
             )
             prof.wait += time.perf_counter() - _t_recv
             try:
@@ -492,7 +566,7 @@ class WalkEngine:
                 if wire is not None:
                     # fused decode + f32 accumulate: one pass, one
                     # quantization deep (the sender's encode)
-                    decode_accumulate(acc, rb, re_, incoming, wire, w.op)
+                    decode_accumulate_any(acc, rb, re_, incoming, wire, w.op)
                 else:
                     reduce_segment(acc, rb, re_, incoming, w.op)
             finally:
@@ -502,7 +576,7 @@ class WalkEngine:
             if scratch is not None:
                 bufpool.put(scratch)
 
-        def recv_ag(name: str, rb: int, re_: int) -> None:
+        def recv_ag(name: str, seg: int, rb: int, re_: int) -> None:
             remaining = deadline - time.monotonic()
             if remaining <= 0:
                 raise TimeoutError(f"segmented walk timed out: {name}")
@@ -527,9 +601,13 @@ class WalkEngine:
             # wire mode: deliver straight into the wire buffer slice —
             # no scratch, no decode (the segment is relayed as-is and
             # decoded once at walk end)
+            if qoff is not None:
+                byte_lo, byte_hi = qoff[seg], qoff[seg + 1]
+            else:
+                byte_lo, byte_hi = rb * 2, re_ * 2
             _t_recv = time.perf_counter()
             msg, filled = self.endpoint.recv_into(
-                recv_peer, name, memoryview(wirebuf)[rb * 2 : re_ * 2],
+                recv_peer, name, memoryview(wirebuf)[byte_lo:byte_hi],
                 remaining,
             )
             prof.wait += time.perf_counter() - _t_recv
@@ -539,10 +617,17 @@ class WalkEngine:
                 raise TimeoutError(f"collective cancelled: {name}")
             if not filled:
                 try:
-                    np.copyto(
-                        wirearr[rb:re_],
-                        np.frombuffer(msg.data, np.uint16, re_ - rb),
-                    )
+                    if qoff is not None:
+                        np.copyto(
+                            wirearr[byte_lo:byte_hi],
+                            np.frombuffer(msg.data, np.uint8,
+                                          byte_hi - byte_lo),
+                        )
+                    else:
+                        np.copyto(
+                            wirearr[rb:re_],
+                            np.frombuffer(msg.data, np.uint16, re_ - rb),
+                        )
                 finally:
                     if msg.release is not None:
                         msg.release()
@@ -566,20 +651,22 @@ class WalkEngine:
             # the pool thread and overlap the predecessor wait, awaited
             # at step end (disjoint segments make this safe).
             if se > sb:
-                wire_bytes += (se - sb) * wire_itemsize
+                wire_bytes += seg_wire_nbytes(se - sb)
                 raw_bytes += (se - sb) * itemsize
             if wire is not None:
                 pending = None
                 if se > sb:
-                    pending = start_send_wire(
-                        name, sb, se,
-                        acc[sb:se] if phase == "rs" else wirearr[sb:se],
-                    )
+                    if phase == "rs":
+                        ef = ef_full[sb:se] if ef_full is not None else None
+                        pending = start_send_wire(name, sb, se, acc[sb:se], ef)
+                    else:
+                        pending = start_send_wire(name, sb, se,
+                                                  ag_slice(send_seg))
                 if re_ > rb:
                     if phase == "rs":
                         recv_rs(name, rb, re_)
                     else:
-                        recv_ag(name, rb, re_)
+                        recv_ag(name, recv_seg, rb, re_)
                 if pending is not None:
                     finish_send(pending, name)
                 return
@@ -589,7 +676,7 @@ class WalkEngine:
                 if phase == "rs":
                     recv_rs(name, rb, re_)
                 else:
-                    recv_ag(name, rb, re_)
+                    recv_ag(name, recv_seg, rb, re_)
 
         def timed_step(span_name: str, phase: str, s: int, snd: int, rcv: int) -> None:
             """One ring step, with a per-step span (subject to
@@ -628,7 +715,18 @@ class WalkEngine:
             # this same encoding, so results stay bit-identical ringwide
             ob, oe = bounds[sched.owned_segment]
             if oe > ob:
-                encode_wire(wirearr[ob:oe], acc[ob:oe], wire)
+                ef = None
+                if isinstance(wire, QWire):
+                    if ef_owned is not None and ef_owned.size != oe - ob:
+                        raise ValueError(
+                            f"ef residual of {ef_owned.size} elements for "
+                            f"owned segment [{ob}:{oe}) — caller sharded "
+                            "differently"
+                        )
+                    ef = ef_owned
+                    if ef is None and ef_full is not None:
+                        ef = ef_full[ob:oe]
+                encode_seg(ag_slice(sched.owned_segment), ob, oe, ef)
         for s, (snd, rcv) in enumerate(sched.ag_steps):
             timed_step("host.ag.step", "ag", s, snd, rcv)
         if cancel is not None and cancel.is_set():
@@ -639,11 +737,19 @@ class WalkEngine:
             raise TimeoutError(f"collective cancelled: {w.name}")
         deferred: Optional[DeferredDecode] = None
         if wire is not None:
-            if defer_decode:
+            if defer_decode and qoff is None:
                 deferred = DeferredDecode(wire, wirebuf, wirearr)
+            elif qoff is not None:
+                # block-scaled: segments decode individually (each one's
+                # scale blocks are relative to its own start)
+                with trace.span("host.wire.decode", bytes=int(qoff[-1])):
+                    for i, (b, e) in enumerate(bounds):
+                        if e > b:
+                            decode_wire_any(acc[b:e], ag_slice(i), wire)
+                bufpool.put(wirebuf)
             else:
                 with trace.span("host.wire.decode", bytes=int(acc.size * 2)):
-                    decode_wire(acc, wirearr, wire)
+                    decode_wire_any(acc, wirearr, wire)
                 bufpool.put(wirebuf)
         self._count_wire(
             wire_bytes, Strategy.RING_SEGMENTED.name, codec_label, raw_bytes
@@ -857,10 +963,14 @@ class WalkEngine:
                     prof.send += time.perf_counter() - _t_send
                 return
             scratch = bufpool.get(wire_nbytes)
-            enc = np.frombuffer(scratch, np.uint16, w.recv.size)
+            enc = np.frombuffer(scratch, wire_np_dtype, wire_count)
             # the fan-out encode is codec COMPUTE (the residual bucket),
-            # so only the transport fan-out below is timed as send
-            encode_wire(enc, effective(), wire)
+            # so only the transport fan-out below is timed as send.
+            # Quantized payloads re-encode idempotently (pow2 scales):
+            # a relay that decoded q-bytes re-produces those exact
+            # bytes, so graph fan-outs need no error feedback to stay
+            # bit-identical.
+            encode_wire_any(enc, effective(), wire)
 
             def send_enc(peer: PeerID) -> None:
                 self.client.send(
@@ -876,13 +986,23 @@ class WalkEngine:
 
         bufpool = get_buffer_pool()
         nbytes = w.recv.size * w.recv.itemsize
-        wire_nbytes = w.recv.size * 2 if wire is not None else nbytes
-        recv_dtype = np.dtype(np.uint16) if wire is not None else w.send.dtype
+        wire_nbytes = (
+            _wire_payload_nbytes(w.recv.size, wire) if wire is not None
+            else nbytes
+        )
+        if isinstance(wire, QWire):
+            # block-scaled payload: scales + packed bytes, u8-framed
+            wire_np_dtype, wire_count = np.dtype(np.uint8), wire_nbytes
+        elif wire is not None:
+            wire_np_dtype, wire_count = np.dtype(np.uint16), w.recv.size
+        else:
+            wire_np_dtype, wire_count = w.send.dtype, w.recv.size
 
         def recv_payload(peer: PeerID):
             """See _recv_collective (shared with the segmented walk)."""
             return self._recv_collective(
-                peer, w.name, wire_nbytes, recv_dtype, w.recv.size, self.timeout
+                peer, w.name, wire_nbytes, wire_np_dtype, wire_count,
+                self.timeout
             )
 
         def recv_onto(peer: PeerID) -> None:
@@ -898,10 +1018,10 @@ class WalkEngine:
                         if state["recv_count"] == 0 and not w.is_inplace:
                             # first arrival: recv = decode(incoming), then
                             # fold own send in f32 (ops are commutative)
-                            decode_wire(w.recv, incoming, wire)
+                            decode_wire_any(w.recv, incoming, wire)
                             reduce_inplace(w.recv, w.send, w.op)
                         else:
-                            decode_accumulate(
+                            decode_accumulate_any(
                                 w.recv, 0, w.recv.size, incoming, wire, w.op
                             )
                     elif state["recv_count"] == 0 and not w.is_inplace:
@@ -957,7 +1077,7 @@ class WalkEngine:
                         if not w.is_inplace:
                             w.forward()
                         for incoming, _, _ in got:
-                            decode_accumulate(
+                            decode_accumulate_any(
                                 w.recv, 0, w.recv.size, incoming, wire, w.op
                             )
                     elif w.is_inplace:
@@ -985,7 +1105,7 @@ class WalkEngine:
                     if cancel.is_set():
                         raise TimeoutError(f"collective cancelled: {w.name}")
                     if wire is not None:
-                        decode_wire(w.recv, incoming, wire)
+                        decode_wire_any(w.recv, incoming, wire)
                     else:
                         np.copyto(w.recv, incoming)
                     state["recv_count"] += 1
@@ -1035,9 +1155,9 @@ class WalkEngine:
             # decodes the quantized broadcast: roundtrip the root's recv
             # through the codec so all peers land on bit-identical values
             scratch = bufpool.get(wire_nbytes)
-            enc = np.frombuffer(scratch, np.uint16, w.recv.size)
-            encode_wire(enc, w.recv, wire)
-            decode_wire(w.recv, enc, wire)
+            enc = np.frombuffer(scratch, wire_np_dtype, wire_count)
+            encode_wire_any(enc, w.recv, wire)
+            decode_wire_any(w.recv, enc, wire)
             bufpool.put(scratch)
         wall = time.perf_counter() - _t_walk
         trace.record(f"host.walk[{w.recv.nbytes >> 20}MiB]", wall)

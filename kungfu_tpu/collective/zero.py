@@ -168,7 +168,7 @@ class _Bucket:
 
     __slots__ = (
         "index", "names", "params", "sizes", "offsets", "total",
-        "W", "ob", "oe", "master", "state", "settled",
+        "W", "ob", "oe", "master", "state", "settled", "wres",
     )
 
     def __init__(self, index: int, names, params, opt: ShardedSGD,
@@ -200,6 +200,14 @@ class _Bucket:
         # the master integrates sub-ULP updates the mirror would lose.
         self.master = self.W[self.ob:self.oe].copy()
         self.state = opt.init(self.oe - self.ob)
+        # error-feedback residual of the quantized weight all-gather
+        # (ISSUE 20): the masters hold the exact weights, so the mirror's
+        # per-step quantization error telescopes instead of compounding.
+        # Per-shard (the gather names are round-stamped, so the session's
+        # name-keyed store would never re-hit); reset to zero on every
+        # re-shard — post_replan restores exact masters, so a zero
+        # residual is the deterministic restart on every peer.
+        self.wres = np.zeros(self.oe - self.ob, np.float32)
 
     def state_bytes(self) -> int:
         n = self.master.nbytes
@@ -215,6 +223,7 @@ class _Bucket:
         self.ob, self.oe = bounds
         self.master = np.empty(self.oe - self.ob, np.float32)
         self.state = opt.init(self.oe - self.ob)
+        self.wres = np.zeros(self.oe - self.ob, np.float32)
 
 
 class ShardedUpdateSession:
@@ -286,6 +295,12 @@ class ShardedUpdateSession:
         # under the OLD layout, post_replan re-slices under the new
         if hasattr(session, "add_replan_listener"):
             session.add_replan_listener(self)
+        # quantized-codec residual lifecycle (ISSUE 20): any session
+        # flush (wire-mode flip, precision vote, re-plan) must reach the
+        # per-shard weight residuals too — stale residuals measure the
+        # old codec/layout and would corrupt the next gather
+        if hasattr(session, "add_ef_flush_listener"):
+            session.add_ef_flush_listener(self._reset_weight_residuals)
         self._sync_round = 0
         self._export_seq = 0
         self._lock = threading.Lock()
@@ -592,6 +607,7 @@ class ShardedUpdateSession:
             b.W,
             f"{self._prefix}:zag:{item.tag}{item.rnd}:b{item.zindex}",
             cancel=cancel,
+            ef=b.wres,
         )
         return item
 
@@ -646,6 +662,14 @@ class ShardedUpdateSession:
                 )
                 leaves.append(full)
         return pack_leaves(leaves)
+
+    def _reset_weight_residuals(self, reason: str) -> None:
+        """Session ef-flush hook (ISSUE 20): zero every bucket's weight
+        all-gather residual. Deterministic on every peer — the masters
+        stay exact, so dropping the carried remainder costs at most one
+        quantization step on the NEXT gather, never correctness."""
+        for b in self._buckets:
+            b.wres[:] = 0.0
 
     # ------------------------------------------------------------------
     # measured-topology re-plan hooks (ISSUE 14)

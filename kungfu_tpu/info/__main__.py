@@ -492,6 +492,20 @@ def _render_ring_lines(doc: dict, peers: list, idx: dict) -> list:
             not _same_cycle(list(active), list(peers))
         ) else " (rank order)"
         lines.append(f"active ring:    {fmt(active)}{star}")
+    # active wire precision (ISSUE 20): cluster-agreed by the lockstep
+    # precision votes, so one value is the norm — a split view means a
+    # scrape straddled a flip (or a real codec divergence: investigate)
+    wire = ring.get("wire") or {}
+    if wire:
+        modes = sorted(set(wire.values()))
+        if len(modes) == 1:
+            lines.append(f"wire precision: {modes[0]}")
+        else:
+            split = ", ".join(
+                f"[{idx.get(p, '?')}]={m}" for p, m in sorted(
+                    wire.items(), key=lambda kv: idx.get(kv[0], len(idx)))
+            )
+            lines.append(f"wire precision: SPLIT ({split}) ⚠")
     # two-level hierarchy (ISSUE 19): the workers' exported roles name
     # host groups, the head carrying each group's inter-host leg, and
     # demoted peers (▽ — zero-weight, served by broadcast)

@@ -430,16 +430,23 @@ _knob("KF_CONFIG_ALGO", "",
       section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
       default_doc="(unset: no override)")
 _knob("KF_CONFIG_WIRE", "",
-      _choice("KF_CONFIG_WIRE", ("off", "bf16", "f16", "auto"),
+      _choice("KF_CONFIG_WIRE", ("off", "bf16", "f16", "auto", "int8", "int4"),
               empty_as="off"),
-      "Compressed wire format for f32 allreduce payloads (bf16/f16 with "
-      "f32 ring accumulation); `auto` resolves to bf16 for eligible "
-      "payloads. Cluster-agreed.",
+      "Compressed wire format for f32 allreduce payloads: bf16/f16 "
+      "(2-byte, f32 ring accumulation), or block-scaled int8/int4 with "
+      "error-feedback residuals (`KF_WIRE_BLOCK` elements per scale); "
+      "`auto` resolves to bf16 for eligible payloads. Cluster-agreed.",
       section=_SEC_ENGINE, kind="choice", strict=True, consensus=True,
       default_doc="off")
 _knob("KF_CONFIG_WIRE_MIN_BYTES", str(64 << 10), _int,
       "Payloads below this bypass the wire codec (keeps probe-sized "
       "monitored traffic exact). Cluster-agreed.",
+      section=_SEC_ENGINE, kind="int", consensus=True)
+_knob("KF_WIRE_BLOCK", "16", _int,
+      "Elements per absmax scale block of the int8/int4 wire codec "
+      "(one f32 scale per block: smaller blocks track outliers, bigger "
+      "blocks amortize the 4-byte scale). Cluster-agreed: it decides "
+      "the byte length of every quantized message.",
       section=_SEC_ENGINE, kind="int", consensus=True)
 _knob("KF_CONFIG_CHUNK_BYTES", "0", _int,
       "Overrides the chunked-walk chunk size heuristic (0 = heuristic). "
@@ -628,9 +635,13 @@ def get(name: str):
         return k.parse(k.default)
     try:
         return k.parse(v)
-    except (ValueError, TypeError):
+    except (ValueError, TypeError) as e:
         if k.strict:
-            raise
+            # name the knob: a bare "invalid literal for int()" from a
+            # cluster-agreed knob gives the operator nothing to grep for
+            if name in str(e):
+                raise
+            raise ValueError(f"{name}: {e}") from None
         # import here, not at module level: the logger reads knobs too
         from kungfu_tpu.telemetry import log
 

@@ -563,6 +563,152 @@ class ReplanPolicy(BasePolicy):
                 ctx.metrics["replan/last_order"] = list(adopted.order)
 
 
+class PrecisionPolicy(BasePolicy):
+    """Adaptive wire-precision driver (ISSUE 20): chooses the collective
+    codec — bf16 / int8 / int4 — from the measured gradient noise scale
+    and votes flips through ``HostSession.check_precision``, a lockstep
+    majority round run every ``interval_steps`` on EVERY peer exactly
+    like the re-plan and interference votes (peers with no opinion vote
+    to keep the current mode; the majority decides).
+
+    The signal: ``kungfu_noise_scale`` (McCandlish B_noise, published by
+    ``monitor.noise_scale.publish_noise_scale`` from an on-device psum —
+    identical on every peer) relative to the actual batch size. When
+    B_noise >> B the minibatch gradient is already dominated by sampling
+    noise, so block-scaled quantization noise (bounded by half a scale
+    step per element — docs/collectives.md) is negligible and the wire
+    can drop to int8, then int4; when B_noise falls toward B the
+    gradient is informative and the policy votes back up to bf16.
+    ``monitor/noise_scale`` in ``ctx.metrics`` overrides the gauge when
+    an embedder or the cluster plane supplies it.
+
+    A target must persist for ``patience`` consecutive vote rounds
+    before this peer proposes it — one noisy estimate never flips the
+    cluster. Every adopted flip opens a ``precision_switch`` decision
+    record; if the ledger closes it ``regressed`` (throughput- or
+    accuracy-hostile: step times got worse), the policy votes straight
+    back to the pre-flip mode and then holds ``cooldown_intervals``
+    vote rounds before proposing another downshift — the rollback
+    contract that makes an aggressive downshift safe to try."""
+
+    def __init__(
+        self,
+        interval_steps: int = 32,
+        patience: int = 3,
+        int8_ratio: float = 8.0,
+        int4_ratio: float = 64.0,
+        cooldown_intervals: int = 8,
+        session_supplier: Optional[Callable[[], object]] = None,
+    ):
+        if interval_steps < 1:
+            raise ValueError("interval_steps must be >= 1")
+        if not (int4_ratio >= int8_ratio > 0):
+            raise ValueError("need int4_ratio >= int8_ratio > 0")
+        self.interval_steps = interval_steps
+        self.patience = max(1, patience)
+        self.int8_ratio = float(int8_ratio)
+        self.int4_ratio = float(int4_ratio)
+        self.cooldown_intervals = max(0, cooldown_intervals)
+        self._session_supplier = session_supplier
+        self._want: Optional[str] = None  # the persistent target watched
+        self._streak = 0
+        self._flip_old: Optional[str] = None  # mode before our last flip
+        self._cooldown = 0
+
+    def _session(self):
+        if self._session_supplier is not None:
+            return self._session_supplier()
+        try:
+            from kungfu_tpu.peer import get_default_peer
+
+            return get_default_peer().current_session()
+        except Exception as e:  # noqa: BLE001 - no peer = nothing to vote on
+            log.debug("precision policy: no session: %s", e)
+            return None
+
+    def _target(self, ctx: "PolicyContext", signals: dict) -> Optional[str]:
+        """The mode this peer believes the measured noise justifies, or
+        None when no (finite, positive) noise estimate is available."""
+        noise = ctx.metrics.get("monitor/noise_scale")
+        if noise is None:
+            try:
+                from kungfu_tpu.telemetry import metrics as _tm
+
+                m = _tm.get_registry().get("kungfu_noise_scale")
+                noise = m.value if m is not None else None
+            except Exception as e:  # noqa: BLE001 - metrics plane optional
+                log.debug("precision policy: no noise gauge: %s", e)
+                noise = None
+        batch = ctx.batch_size
+        if not isinstance(noise, (int, float)) or not noise > 0 or batch <= 0:
+            return None
+        ratio = float(noise) / float(batch)
+        signals["noise_scale"] = float(noise)
+        signals["batch_size"] = int(batch)
+        signals["noise_ratio"] = ratio
+        if ratio >= self.int4_ratio:
+            return "int4"
+        if ratio >= self.int8_ratio:
+            return "int8"
+        return "bf16"
+
+    def after_step(self, ctx: "PolicyContext") -> None:
+        if ctx.step == 0 or ctx.step % self.interval_steps:
+            return
+        sess = self._session()
+        if (
+            sess is None
+            or getattr(sess, "size", 1) < 2
+            or not hasattr(sess, "check_precision")
+        ):
+            return
+        current = sess.active_wire_mode()
+        if self._cooldown > 0:
+            self._cooldown -= 1
+        proposal: Optional[str] = None
+        trigger = "noise_scale"
+        signals: dict = {}
+        regressed = ctx.metrics.get("decision/regressed") or []
+        if "precision_switch" in regressed and self._flip_old is not None \
+                and self._flip_old != current:
+            # the ledger measured our flip hostile: vote straight back
+            proposal = self._flip_old
+            trigger = "regression_rollback"
+        else:
+            target = self._target(ctx, signals)
+            if target is not None and target == self._want:
+                self._streak += 1
+            else:
+                self._want = target
+                self._streak = 1 if target is not None else 0
+            wants_flip = (
+                target is not None
+                and target != current
+                and self._streak >= self.patience
+            )
+            if wants_flip and self._cooldown > 0:
+                ctx.metrics["precision/vote_withheld_cooldown"] = \
+                    self._cooldown
+                wants_flip = False
+            if wants_flip:
+                proposal = target
+        # the vote is a lockstep collective: run it EVERY interval on
+        # every peer, opinion or not — a silent peer would hang the rest
+        new = sess.check_precision(
+            proposal, trigger=trigger, signals=signals or None
+        )
+        if new is not None:
+            if trigger == "regression_rollback":
+                # rolled back: don't re-roll the rollback, and hold off
+                # further downshift proposals for the cooldown window
+                self._flip_old = None
+                self._cooldown = self.cooldown_intervals
+            else:
+                self._flip_old = current
+            self._streak = 0
+            ctx.metrics["precision/active"] = new
+
+
 class _Scope:
     def __init__(self, enter, exit):
         self._enter = enter

@@ -100,6 +100,11 @@ RING_NEXT = "kungfu_topology_ring_next"
 # head / "intra" member / "flat") and role, value = host-group index, so
 # the links view can render the ACTIVE hierarchy (groups, heads, demoted)
 RING_ROLE = "kungfu_topology_ring_role"
+# active wire precision (ISSUE 20): each worker exports the RUNNING
+# codec mode of its collective session (off/bf16/f16/int8/int4 — config
+# + lockstep precision votes), so `info links` can render what the
+# cluster's payloads actually cross the transport as
+WIRE_MODE = "kungfu_collective_wire_mode"
 
 CLOCK_HEADER = "X-KF-Perf-Now-Us"
 
@@ -256,6 +261,7 @@ def parse_worker_page(text: str) -> dict:
     ring_pos = None
     ring_next = None
     ring_role = None
+    wire_mode = None
     _link_key = {
         LINK_BW: "bw", LINK_LAT: "latency_s",
         LINK_BYTES: "tx_bytes", LINK_MSGS: "tx_messages",
@@ -279,6 +285,8 @@ def parse_worker_page(text: str) -> dict:
             d = s.labels_dict()
             ring_role = {"level": d.get("level"), "role": d.get("role"),
                          "group": int(s.value)}
+        elif s.name == WIRE_MODE and s.value:
+            wire_mode = s.labels_dict().get("mode") or wire_mode
         elif s.name in _link_key:
             dst = s.labels_dict().get("dst")
             if dst:
@@ -294,6 +302,7 @@ def parse_worker_page(text: str) -> dict:
         "ring_pos": ring_pos,
         "ring_next": ring_next,
         "ring_role": ring_role,
+        "wire_mode": wire_mode,
     }
 
 
@@ -320,6 +329,7 @@ def parsed_from_doc(doc: dict) -> dict:
     parsed.setdefault("ring_pos", None)
     parsed.setdefault("ring_next", None)
     parsed.setdefault("ring_role", None)
+    parsed.setdefault("wire_mode", None)
     return parsed
 
 
@@ -439,6 +449,8 @@ class PeerState:
         self.ring_next: Optional[str] = None
         # two-level role (ISSUE 19): {"level","role","group"} or None
         self.ring_role: Optional[dict] = None
+        # active wire precision (ISSUE 20): the RUNNING codec mode
+        self.wire_mode: Optional[str] = None
         # per-(peer, endpoint) freshness (ISSUE 18 fix): a peer failing
         # ONE endpoint mid-sweep used to leave that plane's previous
         # payload silently current — last_ok only tracked /metrics.
@@ -807,6 +819,7 @@ class TelemetryAggregator:
         # would keep steering topology re-planning hours later
         st.links = {}
         st.ring_pos = st.ring_next = st.ring_role = None
+        st.wire_mode = None
         # scale mode: the sampled-matrix cache row too, for the same
         # reason (and a dead incarnation's delta cursors are garbage
         # to the respawn's restarted seq spaces)
@@ -829,6 +842,7 @@ class TelemetryAggregator:
         st.ring_pos = parsed.get("ring_pos")
         st.ring_next = parsed.get("ring_next")
         st.ring_role = parsed.get("ring_role")
+        st.wire_mode = parsed.get("wire_mode")
         st.coll_sum = parsed.get("coll_sum")
         st.bytes_tx, st.bytes_rx = parsed.get("bytes_tx"), parsed.get("bytes_rx")
         st.reported_rtt = parsed.get("reported_rtt")
@@ -1554,6 +1568,13 @@ class TelemetryAggregator:
             "role": {
                 st.label: st.ring_role for st in self.peers()
                 if st.ring_role is not None
+            },
+            # active wire precision (ISSUE 20): cluster-agreed by the
+            # lockstep votes, so these normally all match — a divergence
+            # here is a scrape straddling a flip (or a real bug)
+            "wire": {
+                st.label: st.wire_mode for st in self.peers()
+                if st.wire_mode is not None
             },
         }
 

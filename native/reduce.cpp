@@ -446,3 +446,134 @@ extern "C" int kf_decode_accumulate(void *acc, const void *src, int64_t count,
   }
   return -1;
 }
+
+// --- block-scaled int8/int4 wire codec ---------------------------------
+//
+// Per-block power-of-two absmax scaling: each `block`-element run of the
+// f32 segment gets one f32 scale s = 2^ceil(log2(absmax / Qmax)) (Qmax =
+// 127 for int8, 7 for int4), then q = clamp(rint(x * (1/s)), -Qmax, Qmax)
+// packed as signed bytes (int8) or two's-complement low-nibble-first
+// pairs (int4). The pow2 scale is the idempotency lever: decode s*q is
+// EXACT in f32 (power of two times a small integer), and re-encoding a
+// decoded block re-derives the identical s and identical q — so graph-
+// walk relays and the bcast-root roundtrip stay bit-identical, the same
+// contract the 16-bit codec gets for free from dtype narrowing.
+//
+// Layout of an encoded segment of `count` elements:
+//   [ceil(count/block) f32 little-endian scales][payload]
+// payload = count bytes (int8) or ceil(count/2) bytes (int4, odd count
+// leaves the last high nibble zero). Scales are memcpy'd at arbitrary
+// byte offsets — no alignment requirement (segments start anywhere).
+//
+// Rounding contract: scale derivation is fl(absmax/Qmax) -> frexp ->
+// ldexp and quantization is rint (round-to-nearest-even), bit-matching
+// the numpy fallback's np.frexp/np.ldexp/np.rint path in base/ops.py.
+
+#include <cmath>
+
+namespace {
+
+inline float q_block_scale(const float *s, size_t n, float qmax) {
+  float amax = 0.0f;
+  for (size_t i = 0; i < n; ++i) {
+    float a = s[i] < 0.0f ? -s[i] : s[i];
+    if (a > amax) amax = a;
+  }
+  if (amax == 0.0f) return 0.0f;
+  float t = amax / qmax;
+  int e;
+  float m = frexpf(t, &e);  // t = m * 2^e, m in [0.5, 1)
+  return ldexpf(1.0f, m == 0.5f ? e - 1 : e);  // 2^ceil(log2(t))
+}
+
+inline int8_t q_unpack4(const uint8_t *payload, size_t i) {
+  uint8_t nib = (uint8_t)((payload[i >> 1] >> ((i & 1) ? 4 : 0)) & 0xFu);
+  return (int8_t)(nib >= 8u ? (int)nib - 16 : (int)nib);
+}
+
+}  // namespace
+
+extern "C" int kf_encode_wire_q(void *dst, const void *src, int64_t count,
+                                int32_t bits, int32_t block) {
+  if (count < 0 || block < 1 || (bits != 8 && bits != 4)) return -1;
+  const float *s = (const float *)src;
+  size_t n = (size_t)count;
+  size_t nb = (n + (size_t)block - 1) / (size_t)block;
+  uint8_t *scales = (uint8_t *)dst;
+  uint8_t *payload = scales + 4 * nb;
+  const float qmax = bits == 8 ? 127.0f : 7.0f;
+  for (size_t b = 0; b < nb; ++b) {
+    size_t lo = b * (size_t)block;
+    size_t hi = lo + (size_t)block;
+    if (hi > n) hi = n;
+    float scale = q_block_scale(s + lo, hi - lo, qmax);
+    __builtin_memcpy(scales + 4 * b, &scale, 4);
+    float inv = scale == 0.0f ? 0.0f : 1.0f / scale;  // pow2: exact
+    for (size_t i = lo; i < hi; ++i) {
+      float q = rintf(s[i] * inv);
+      if (q > qmax) q = qmax;
+      if (q < -qmax) q = -qmax;
+      int8_t qi = (int8_t)q;
+      if (bits == 8) {
+        payload[i] = (uint8_t)qi;
+      } else if (i & 1) {
+        payload[i >> 1] = (uint8_t)(payload[i >> 1] | (((uint8_t)qi & 0xFu) << 4));
+      } else {
+        payload[i >> 1] = (uint8_t)((uint8_t)qi & 0xFu);
+      }
+    }
+  }
+  return 0;
+}
+
+extern "C" int kf_decode_wire_q(void *dst, const void *src, int64_t count,
+                                int32_t bits, int32_t block) {
+  if (count < 0 || block < 1 || (bits != 8 && bits != 4)) return -1;
+  float *d = (float *)dst;
+  size_t n = (size_t)count;
+  size_t nb = (n + (size_t)block - 1) / (size_t)block;
+  const uint8_t *scales = (const uint8_t *)src;
+  const uint8_t *payload = scales + 4 * nb;
+  for (size_t b = 0; b < nb; ++b) {
+    size_t lo = b * (size_t)block;
+    size_t hi = lo + (size_t)block;
+    if (hi > n) hi = n;
+    float scale;
+    __builtin_memcpy(&scale, scales + 4 * b, 4);
+    if (bits == 8) {
+      for (size_t i = lo; i < hi; ++i) d[i] = scale * (float)(int8_t)payload[i];
+    } else {
+      for (size_t i = lo; i < hi; ++i) d[i] = scale * (float)q_unpack4(payload, i);
+    }
+  }
+  return 0;
+}
+
+extern "C" int kf_decode_accumulate_q(void *acc, const void *src, int64_t count,
+                                      int32_t bits, int32_t block, int32_t op) {
+  if (count < 0 || block < 1 || (bits != 8 && bits != 4)) return -1;
+  float *a = (float *)acc;
+  size_t n = (size_t)count;
+  size_t nb = (n + (size_t)block - 1) / (size_t)block;
+  const uint8_t *scales = (const uint8_t *)src;
+  const uint8_t *payload = scales + 4 * nb;
+  for (size_t b = 0; b < nb; ++b) {
+    size_t lo = b * (size_t)block;
+    size_t hi = lo + (size_t)block;
+    if (hi > n) hi = n;
+    float scale;
+    __builtin_memcpy(&scale, scales + 4 * b, 4);
+    for (size_t i = lo; i < hi; ++i) {
+      float v = scale * (float)(bits == 8 ? (int8_t)payload[i]
+                                          : q_unpack4(payload, i));
+      switch (op) {
+        case SUM:  a[i] += v; break;
+        case MIN:  a[i] = a[i] < v ? a[i] : v; break;
+        case MAX:  a[i] = a[i] > v ? a[i] : v; break;
+        case PROD: a[i] *= v; break;
+        default: return -1;
+      }
+    }
+  }
+  return 0;
+}

@@ -28,12 +28,28 @@ extern "C" int kf_decode_wire(void *dst, const void *src, int64_t count,
                               int32_t wire_dtype);
 extern "C" int kf_decode_accumulate(void *acc, const void *src, int64_t count,
                                     int32_t wire_dtype, int32_t op);
+extern "C" int kf_encode_wire_q(void *dst, const void *src, int64_t count,
+                                int32_t bits, int32_t block);
+extern "C" int kf_decode_wire_q(void *dst, const void *src, int64_t count,
+                                int32_t bits, int32_t block);
+extern "C" int kf_decode_accumulate_q(void *acc, const void *src, int64_t count,
+                                      int32_t bits, int32_t block, int32_t op);
 
 namespace {
 constexpr int32_t F32 = 11, F16 = 9, BF16 = 10, SUM = 0;
 constexpr int64_t N = 1 << 18;     // one "bucket"
 constexpr int THREADS = 8;         // pool threads sharing it
 constexpr int ROUNDS = 16;
+constexpr int32_t QBLOCK = 16;     // KF_WIRE_BLOCK default
+
+// encoded byte length of one n-element segment ([scales][payload],
+// mirroring base/ops.py wire_nbytes_q) — each thread's segment lands in
+// a DISJOINT byte window of the shared wire buffer, like the segmented
+// walk's qoff prefix sums
+int64_t q_nbytes(int64_t n, int32_t bits) {
+  const int64_t nb = (n + QBLOCK - 1) / QBLOCK;
+  return 4 * nb + (bits == 8 ? n : (n + 1) / 2);
+}
 
 int fail(const char *what) {
   std::fprintf(stderr, "sanitizer_smoke: FAILED at %s\n", what);
@@ -78,6 +94,43 @@ int main() {
       if (dec[i] != src[i]) return fail("decode round-trip");
       if (acc[i] != src[i] + 1.0f) return fail("decode-accumulate");
       if (red[i] != dec[i] + acc[i]) return fail("transform2");
+    }
+  }
+
+  // block-scaled int8/int4 kernels (ISSUE 20), same discipline: pool
+  // threads encode disjoint segments of the shared f32 buffer into
+  // DISJOINT byte windows of one shared wire buffer (the walk's qoff
+  // layout), then decode / decode-accumulate back into disjoint slices
+  // of shared outputs. Values are chosen so the pow2 block scale is 1
+  // (absmax 64 -> int8, absmax 7 -> int4) and the round-trip is exact.
+  const int64_t seg = N / THREADS;
+  for (int round = 0; round < ROUNDS; ++round) {
+    const int32_t bits = (round % 2) ? 4 : 8;
+    const int mod = bits == 8 ? 128 : 15;        // absmax 64 / 7
+    const float base = bits == 8 ? 64.0f : 7.0f;
+    for (int64_t i = 0; i < N; ++i) src[i] = (float)(i % mod) - base;
+    std::fill(acc.begin(), acc.end(), 1.0f);
+    const int64_t segb = q_nbytes(seg, bits);
+    std::vector<uint8_t> qwire(THREADS * segb);
+    std::vector<std::thread> ts;
+    ts.reserve(THREADS);
+    for (int t = 0; t < THREADS; ++t) {
+      ts.emplace_back([&, t, bits, segb] {
+        const int64_t sb = t * seg;
+        uint8_t *w = qwire.data() + t * segb;
+        if (kf_encode_wire_q(w, src.data() + sb, seg, bits, QBLOCK))
+          std::exit(2);
+        if (kf_decode_wire_q(dec.data() + sb, w, seg, bits, QBLOCK))
+          std::exit(2);
+        if (kf_decode_accumulate_q(acc.data() + sb, w, seg, bits, QBLOCK,
+                                   SUM))
+          std::exit(2);
+      });
+    }
+    for (auto &t : ts) t.join();
+    for (int64_t i = 0; i < N; i += 997) {
+      if (dec[i] != src[i]) return fail("quantized decode round-trip");
+      if (acc[i] != src[i] + 1.0f) return fail("quantized decode-accumulate");
     }
   }
   std::puts("sanitizer_smoke: ok");

@@ -5,6 +5,12 @@ the engine's real access pattern) against reduce.cpp under
 ThreadSanitizer and RUNS it; any data race exits nonzero. Same for
 `--ubsan`. Gated on the toolchain actually supporting the sanitizer so
 minimal containers skip instead of fail.
+
+ISSUE 20 extends the smoke with the block-scaled int8/int4 kernels
+(kf_encode_wire_q / kf_decode_wire_q / kf_decode_accumulate_q): threads
+encode disjoint f32 segments into disjoint byte windows of one shared
+wire buffer — the segmented walk's qoff layout — so the unaligned
+memcpy'd scale headers and nibble packing run under both sanitizers.
 """
 
 import os

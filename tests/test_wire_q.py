@@ -1,0 +1,797 @@
+"""Block-scaled int8/int4 wire codec with error feedback (ISSUE 20).
+
+Covers: native-vs-numpy parity of the quantized kernels (per-block pow2
+absmax scales, RNE quantize, nibble packing, fused decode-accumulate),
+the stale-.so loader guard for the new symbols, idempotent re-encode
+(the relay/bcast-root bit-identity foundation: decode(encode(x))
+re-encodes to the SAME bytes because block scales are powers of two),
+the quantized allreduce error bound and cross-peer bit-identity across
+np in {2,3,4} and all strategies, the error-feedback residual
+lifecycle (telescoping drift bound over repeated steps with a constant
+workspace name; deterministic flush on wire-mode flips and re-plan
+adoption; fresh store per session epoch; ZeRO's per-shard weight
+residuals resetting through the flush listener and re-sharding across
+plan flips), int8/int4 wire-byte accounting on their own codec label
+series, KF_CONFIG_WIRE / KF_WIRE_BLOCK parsing and KF701 consensus,
+the loud-warn exact-bypass for unknown modes on the lenient path, the
+lockstep check_precision majority vote with its ledger record, the
+PrecisionPolicy noise-ratio thresholds / patience / rollback /
+cooldown contract, and the `info links` wire-precision rendering.
+
+Error model: one block's pow2 scale s satisfies amax/qmax <= s <
+2*amax/qmax, so a single quantization event errs at most s/2 <
+amax/qmax per element. Accumulation stays f32 and re-encodes are
+idempotent, so only genuine reduce steps quantize; with error feedback
+the per-step rounding telescopes and the CUMULATIVE drift over many
+steps stays within a small constant of ONE step's bound instead of
+growing linearly.
+"""
+
+import os
+import shutil
+import subprocess
+import threading
+
+import numpy as np
+import pytest
+
+from kungfu_tpu import knobs
+from kungfu_tpu.base import ops
+from kungfu_tpu.base import _native_reduce as native
+from kungfu_tpu.base.ops import QWire, ReduceOp, wire_nbytes_q
+from kungfu_tpu.base.strategy import Strategy
+from kungfu_tpu.base.workspace import Workspace
+from kungfu_tpu.collective.host_session import HostSession, wire_override
+from kungfu_tpu.plan import replan as rp
+
+from test_segmented import make_peer_cluster, _sessions, _run_on_all
+
+QMAX = {8: 127.0, 4: 7.0}
+# one wire quantization step, relative to the block absmax: the pow2
+# scale is < 2*amax/qmax, so "two steps" = 4*amax/qmax covers the
+# (k-1)-deep reduce chains of every tested np with one constant
+QEPS = {8: 2.0 / 127.0, 4: 2.0 / 7.0}
+QMODES = ["int8", "int4"]
+BITS = {"int8": 8, "int4": 4}
+
+
+def _qpayload(n=4099, seed=3):
+    """Finite values spanning magnitudes, zero blocks and sign flips."""
+    rng = np.random.default_rng(seed)
+    out = np.concatenate([
+        rng.uniform(-1e4, 1e4, n // 3).astype(np.float32),
+        rng.normal(0, 1e-5, n // 3).astype(np.float32),
+        rng.normal(0, 1.0, n - 2 * (n // 3)).astype(np.float32),
+    ])
+    out[:32] = 0.0  # all-zero leading blocks -> scale 0 path
+    return out.copy()
+
+
+# ---------------------------------------------------------------------------
+# kernel parity: native == numpy fallback, bit for bit
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", QMODES)
+@pytest.mark.parametrize("block", [16, 5])
+def test_q_fallback_matches_native(mode, block, monkeypatch):
+    """ops.*_q must produce IDENTICAL bytes with and without the native
+    kernels — the graceful-degradation contract (a fallback peer in a
+    native cluster would otherwise frame different message bytes)."""
+    if not native.has_wire_codec_q:
+        pytest.skip("native quantized codec not built")
+    wire = QWire(BITS[mode], block)
+    src = _qpayload()
+    n = src.size
+    acc0 = np.random.default_rng(5).normal(0, 2, n).astype(np.float32)
+
+    def run_all():
+        enc = np.empty(wire_nbytes_q(n, wire.bits, wire.block), np.uint8)
+        ops.encode_wire_q(enc, src, wire)
+        dec = np.empty(n, np.float32)
+        ops.decode_wire_q(dec, enc, wire)
+        accs = []
+        for op in ReduceOp:
+            acc = acc0.copy()
+            ops.decode_accumulate_q(acc, 0, n, enc, wire, op)
+            accs.append(acc)
+        return [enc, dec] + accs
+
+    with_native = run_all()
+    monkeypatch.setattr(native, "has_wire_codec_q", False)
+    without = run_all()
+    for a, b in zip(with_native, without):
+        np.testing.assert_array_equal(a, b)
+
+
+@pytest.mark.parametrize("mode", QMODES)
+def test_q_roundtrip_bound_and_special_blocks(mode):
+    """Decoded values stay within half a scale step of the source; an
+    all-zero block decodes to exact zeros (scale-0 path); odd int4
+    counts pack the trailing nibble."""
+    bits = BITS[mode]
+    wire = QWire(bits, 16)
+    for n in (4099, 16, 15, 1):
+        src = _qpayload(n)
+        enc = np.empty(wire_nbytes_q(n, bits, 16), np.uint8)
+        ops.encode_wire_q(enc, src, wire)
+        dec = np.empty(n, np.float32)
+        ops.decode_wire_q(dec, enc, wire)
+        nb = (n + 15) // 16
+        padded = np.zeros(nb * 16, np.float32)
+        padded[:n] = src
+        amax = np.max(np.abs(padded.reshape(nb, 16)), axis=1)
+        step = np.repeat(2.0 * amax / QMAX[bits], 16)[:n]
+        assert np.all(np.abs(dec - src) <= 0.5 * step + 1e-30), (mode, n)
+        zero_blocks = np.repeat(amax == 0.0, 16)[:n]
+        assert np.all(dec[zero_blocks] == 0.0)
+
+
+@pytest.mark.parametrize("mode", QMODES)
+def test_q_reencode_idempotent(mode):
+    """encode(decode(encode(x))) == encode(x) BYTE for byte: decoded
+    values are pow2-scale multiples of small integers, so a relay or a
+    broadcast root re-quantizing them reproduces the identical frame —
+    the mechanism behind cross-peer bit-identity in the graph walks."""
+    wire = QWire(BITS[mode], 16)
+    src = _qpayload()
+    n = src.size
+    nbytes = wire_nbytes_q(n, wire.bits, wire.block)
+    enc = np.empty(nbytes, np.uint8)
+    ops.encode_wire_q(enc, src, wire)
+    dec = np.empty(n, np.float32)
+    ops.decode_wire_q(dec, enc, wire)
+    enc2 = np.empty(nbytes, np.uint8)
+    ops.encode_wire_q(enc2, dec, wire)
+    np.testing.assert_array_equal(enc, enc2)
+
+
+def test_q_wire_nbytes_layout():
+    """[4B scale per block][1B/elem or rounded-up nibbles] exactly."""
+    assert wire_nbytes_q(16, 8, 16) == 4 + 16
+    assert wire_nbytes_q(17, 8, 16) == 8 + 17      # partial tail block
+    assert wire_nbytes_q(16, 4, 16) == 4 + 8
+    assert wire_nbytes_q(15, 4, 16) == 4 + 8       # odd nibble rounds up
+    assert wire_nbytes_q(1, 4, 16) == 4 + 1
+    # the acceptance ratios at block=16: 0.3125x / 0.1875x of 4B/elem
+    assert wire_nbytes_q(1024, 8, 16) / (1024 * 4) == 0.3125
+    assert wire_nbytes_q(1024, 4, 16) / (1024 * 4) == 0.1875
+
+
+def test_loader_guard_q_on_stale_so(tmp_path):
+    """A libkfnative.so that has the 16-bit codec but predates the
+    quantized kernels must load with has_wire_codec_q=False, not blow
+    up ops at import."""
+    cxx = shutil.which("g++") or shutil.which("cc")
+    if cxx is None:
+        pytest.skip("no compiler for the stale-.so fixture")
+    stub_src = tmp_path / "stub.cpp"
+    stub_src.write_text(
+        'extern "C" int kf_transform2(void*, const void*, const void*, '
+        "long long, int, int) { return 0; }\n"
+        'extern "C" int kf_encode_wire(void*, const void*, long long, int) '
+        "{ return 0; }\n"
+    )
+    stub_so = tmp_path / "libstale.so"
+    subprocess.run(
+        [cxx, "-shared", "-fPIC", "-o", str(stub_so), str(stub_src)],
+        check=True,
+    )
+    import ctypes
+
+    lib = ctypes.CDLL(str(stub_so))
+    lib.kf_encode_wire  # the 16-bit symbol resolves
+    for sym in ("kf_encode_wire_q", "kf_decode_wire_q",
+                "kf_decode_accumulate_q"):
+        with pytest.raises(AttributeError):
+            getattr(lib, sym)
+    assert isinstance(native.has_wire_codec_q, bool)
+
+
+# ---------------------------------------------------------------------------
+# quantized allreduce: error bound, bit-identity, error-feedback drift
+# ---------------------------------------------------------------------------
+
+@pytest.fixture(scope="module")
+def clusters():
+    built = {}
+
+    def get(n):
+        if n not in built:
+            built[n] = make_peer_cluster(n)
+        return built[n]
+
+    yield get
+    for ps in built.values():
+        for p in ps:
+            p.stop()
+
+
+WIRE_STRATEGIES = [
+    Strategy.TREE,
+    Strategy.CLIQUE,
+    Strategy.RING,
+    Strategy.STAR,
+    Strategy.RING_SEGMENTED,
+]
+
+
+@pytest.mark.parametrize("np_", [2, 3, 4])
+@pytest.mark.parametrize("mode", QMODES)
+def test_q_error_bound_and_consistency(np_, mode, clusters, monkeypatch):
+    """Quantized allreduce error vs the f32 reference stays within TWO
+    wire quantization steps of the result — the same constant at every
+    np (f32 accumulation + idempotent re-encode: only reduce steps
+    quantize) — and every peer lands on bit-identical outputs."""
+    monkeypatch.setenv("KF_CONFIG_WIRE", mode)
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
+    cluster = clusters(np_)
+    rng = np.random.default_rng(200 + np_)
+    n = 8192
+    xs = [rng.uniform(0.5, 1.0, n).astype(np.float32) for _ in range(np_)]
+    ref = np.sum(xs, axis=0, dtype=np.float32)
+    bound = 2.0 * float(np.abs(ref).max()) * QEPS[BITS[mode]]
+    for strategy in WIRE_STRATEGIES:
+        sessions = _sessions(cluster, strategy)
+        outs = {}
+
+        def run(r, sess):
+            out = np.empty(n, np.float32)
+            sess.all_reduce(Workspace(
+                send=xs[r], recv=out, op=ReduceOp.SUM,
+                name=f"qwire-eq:{mode}:{np_}:{strategy.name}",
+            ))
+            outs[r] = out
+
+        _run_on_all([lambda r=r, s=s: run(r, s)
+                     for r, s in enumerate(sessions)])
+        for r in range(1, np_):
+            np.testing.assert_array_equal(
+                outs[0], outs[r],
+                err_msg=f"{strategy.name} peers diverged under {mode}",
+            )
+        err = float(np.abs(outs[0] - ref).max())
+        assert 0 < err <= bound, (strategy.name, np_, mode, err, bound)
+
+
+@pytest.mark.parametrize("np_", [2, 3, 4])
+@pytest.mark.parametrize("mode", QMODES)
+def test_q_error_feedback_drift_telescopes(np_, mode, clusters, monkeypatch):
+    """T repeated allreduces of the SAME payload under a CONSTANT
+    workspace name (the training-loop pattern the residual store keys
+    on): without error feedback the systematic per-step rounding would
+    accumulate ~linearly in T, with it the cumulative drift of the
+    running sum stays within the same two-wire-step constant as a
+    single step — for every np."""
+    monkeypatch.setenv("KF_CONFIG_WIRE", mode)
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
+    cluster = clusters(np_)
+    rng = np.random.default_rng(300 + np_)
+    n = 8192
+    T = 8
+    xs = [rng.uniform(0.5, 1.0, n).astype(np.float32) for _ in range(np_)]
+    ref = np.sum(xs, axis=0, dtype=np.float32)
+    bound = 2.0 * float(np.abs(ref).max()) * QEPS[BITS[mode]]
+    sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+    cum = {r: np.zeros(n, np.float64) for r in range(np_)}
+
+    def run(r, sess):
+        for _ in range(T):
+            out = np.empty(n, np.float32)
+            sess.all_reduce(Workspace(
+                send=xs[r], recv=out, op=ReduceOp.SUM,
+                name=f"qwire-ef:{mode}:{np_}",
+            ))
+            cum[r] += out
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+    for r in range(1, np_):
+        np.testing.assert_array_equal(cum[0], cum[r])
+    drift = float(np.abs(cum[0] - T * ref.astype(np.float64)).max())
+    # telescoping: cumulative drift over T steps ~ ONE step's bound,
+    # not T of them (2x slack for the residual left in flight)
+    assert drift <= 2.0 * bound, (np_, mode, drift, bound, T)
+    assert any(s._ef_store for s in sessions), "residual store never used"
+
+
+@pytest.mark.parametrize("trigger", ["mode_flip", "replan"])
+def test_q_ef_flush_on_mode_flip_and_replan(trigger, clusters, monkeypatch):
+    """The residual store flushes deterministically when the wire mode
+    changes (residuals measure the OLD codec's rounding) and when a
+    re-plan moves segment ownership (they index the OLD bounds) — and
+    the flush reaches registered listeners (ZeRO's hook)."""
+    monkeypatch.setenv("KF_CONFIG_WIRE", "int8")
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
+    np_ = 2
+    cluster = clusters(np_)
+    sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+    rng = np.random.default_rng(31)
+    xs = [rng.uniform(0.5, 1.0, 4096).astype(np.float32) for _ in range(np_)]
+
+    def run(tag):
+        def one(r, sess):
+            out = np.empty_like(xs[r])
+            sess.all_reduce(Workspace(
+                send=xs[r], recv=out, op=ReduceOp.SUM, name=f"ef-fl:{tag}",
+            ))
+
+        _run_on_all([lambda r=r, s=s: one(r, s)
+                     for r, s in enumerate(sessions)])
+
+    run("seed")
+    assert all(s._ef_store for s in sessions), "store should be populated"
+    reasons = {r: [] for r in range(np_)}
+    for r, s in enumerate(sessions):
+        s.add_ef_flush_listener(reasons[r].append)
+
+    if trigger == "mode_flip":
+        for s in sessions:
+            s._candidates[s.adaptive.active] = (
+                s._candidates[s.adaptive.active][0], "int4",
+            )
+        run("after")  # _wire_codec_for notices the flip and flushes first
+    else:
+        plan = rp.RingPlan(order=(1, 0), weights=(0.3, 0.7))
+        _run_on_all([lambda s=s: s.adopt_replan(plan) for s in sessions])
+    for r, s in enumerate(sessions):
+        assert reasons[r], f"flush listener never ran on rank {r}"
+        if trigger == "replan":
+            assert not s._ef_store, "replan must clear the store"
+            assert "replan" in reasons[r][0]
+        else:
+            assert "int8" in reasons[r][0] and "int4" in reasons[r][0]
+
+
+def test_q_ef_store_fresh_per_session_epoch(clusters, monkeypatch):
+    """A new session epoch (elastic resize rebuilds sessions) starts
+    with an EMPTY residual store — residuals never leak across epochs
+    where peer count / segment bounds changed."""
+    monkeypatch.setenv("KF_CONFIG_WIRE", "int8")
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
+    cluster = clusters(2)
+    sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+    xs = [np.full(4096, np.float32(r + 0.1)) for r in range(2)]
+
+    def one(r, sess):
+        out = np.empty_like(xs[r])
+        sess.all_reduce(Workspace(
+            send=xs[r], recv=out, op=ReduceOp.SUM, name="ef-epoch",
+        ))
+
+    _run_on_all([lambda r=r, s=s: one(r, s) for r, s in enumerate(sessions)])
+    assert all(s._ef_store for s in sessions)
+    fresh = _sessions(cluster, Strategy.RING_SEGMENTED)
+    assert all(not s._ef_store for s in fresh)
+
+
+def test_zero_weight_residuals_reset_and_reshard(clusters, monkeypatch):
+    """ZeRO's per-shard weight residuals (_Bucket.wres): populated by
+    quantized weight all-gathers, zeroed through the session flush
+    listener on a precision flip, and re-allocated to the new owned
+    bounds across a plan flip — while gathered params stay bit-identical
+    on every peer."""
+    from kungfu_tpu.collective.zero import ShardedSGD, ShardedUpdateSession
+
+    monkeypatch.setenv("KF_CONFIG_WIRE", "int8")
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
+    np_ = 2
+    cluster = clusters(np_)
+    sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+    rng = np.random.default_rng(41)
+    n = 4096
+    p0 = rng.uniform(-1.0, 1.0, n).astype(np.float32)
+    params = {r: [p0.copy()] for r in range(np_)}
+    zss = {}
+
+    def build(r, sess):
+        zss[r] = ShardedUpdateSession(
+            params[r], ShardedSGD(0.1), name="qz", session=sess,
+        )
+
+    _run_on_all([lambda r=r, s=s: build(r, s) for r, s in enumerate(sessions)])
+    grads = {r: [rng.uniform(-1, 1, n).astype(np.float32)]
+             for r in range(np_)}
+
+    def step(r):
+        zss[r].step([g.copy() for g in grads[r]])
+
+    _run_on_all([lambda r=r: step(r) for r in range(np_)])
+    assert params[0][0].tobytes() == params[1][0].tobytes()
+    assert any(np.any(zss[r]._buckets[0].wres != 0.0) for r in range(np_)), \
+        "quantized weight gather should leave a residual"
+
+    # a precision flip flushes the session store AND the zero residuals
+    for s in sessions:
+        s._flush_residuals("test flip")
+    for r in range(np_):
+        assert not np.any(zss[r]._buckets[0].wres != 0.0)
+
+    # a plan flip moves the owned bounds: wres re-allocates, zeroed
+    _run_on_all([lambda r=r: step(r) for r in range(np_)])
+    plan = rp.RingPlan(order=(0, 1), weights=(0.25, 0.75))
+    _run_on_all([lambda s=s: s.adopt_replan(plan) for s in sessions])
+    for r, s in enumerate(sessions):
+        b = zss[r]._buckets[0]
+        assert (b.ob, b.oe) == s.owned_bounds(b.total)
+        assert b.wres.size == b.oe - b.ob
+        assert not np.any(b.wres != 0.0)
+    _run_on_all([lambda r=r: step(r) for r in range(np_)])
+    assert params[0][0].tobytes() == params[1][0].tobytes()
+
+
+# ---------------------------------------------------------------------------
+# byte accounting: int8/int4 on their own codec label series
+# ---------------------------------------------------------------------------
+
+@pytest.mark.parametrize("mode", QMODES)
+def test_wire_q_byte_accounting(mode, clusters, monkeypatch):
+    """np=2 RING_SEGMENTED moves exactly 2k(k-1) segment-sends of n/k
+    elements, each framed at wire_nbytes_q; the delta lands on the
+    codec=<mode> series and saved = raw - wire exactly."""
+    from kungfu_tpu.telemetry import config as tconfig
+    from kungfu_tpu.telemetry import metrics as tmetrics
+
+    tconfig.enable("metrics")
+    try:
+        monkeypatch.setenv("KF_CONFIG_WIRE", mode)
+        monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+        monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
+        np_ = 2
+        cluster = clusters(np_)
+        sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+        ctr = tmetrics.counter(
+            "kungfu_collective_wire_bytes_total",
+            "Host-plane collective payload bytes sent by this peer",
+            ("collective", "strategy", "codec"),
+        )
+        child = ctr.labels("all_reduce", "RING_SEGMENTED", mode)
+        saved = tmetrics.counter(
+            "kungfu_collective_wire_saved_bytes_total",
+            "Wire bytes saved by the collective codec on this peer",
+            ("collective", "codec"),
+        )
+        saved_child = saved.labels("all_reduce", mode)
+        before, saved_before = child.value, saved_child.value
+        n = 4096  # divisible by k * block: equal whole-block segments
+        xs = [np.full(n, np.float32(r + 1)) for r in range(np_)]
+        outs = [np.empty_like(x) for x in xs]
+
+        def run(r, sess):
+            sess.all_reduce(Workspace(
+                send=xs[r], recv=outs[r], op=ReduceOp.SUM,
+                name=f"qbytes:{mode}",
+            ))
+
+        _run_on_all([lambda r=r, s=s: run(r, s)
+                     for r, s in enumerate(sessions)])
+        sends = 2 * np_ * (np_ - 1)  # rs + ag segment-sends, cluster-wide
+        expect = sends * wire_nbytes_q(n // np_, BITS[mode],
+                                       HostSession.WIRE_BLOCK)
+        raw = sends * (n // np_) * 4
+        assert child.value - before == expect
+        assert saved_child.value - saved_before == raw - expect
+    finally:
+        tconfig.refresh()
+
+
+# ---------------------------------------------------------------------------
+# knobs: parsing, consensus (KF701 both directions), lenient-path guard
+# ---------------------------------------------------------------------------
+
+def test_wire_override_accepts_q_modes(monkeypatch):
+    for raw, want in [("int8", "int8"), ("INT4", "int4"), (" int8 ", "int8")]:
+        monkeypatch.setenv("KF_CONFIG_WIRE", raw)
+        assert wire_override() == want
+    monkeypatch.setenv("KF_CONFIG_WIRE", "int2")
+    with pytest.raises(ValueError, match="KF_CONFIG_WIRE"):
+        wire_override()
+
+
+def test_wire_block_knob_parsing(monkeypatch):
+    monkeypatch.delenv("KF_WIRE_BLOCK", raising=False)
+    assert int(knobs.get("KF_WIRE_BLOCK")) == 16
+    monkeypatch.setenv("KF_WIRE_BLOCK", "32")
+    assert int(knobs.get("KF_WIRE_BLOCK")) == 32
+    # lenient knob: malformed warns and keeps the default — a peer that
+    # DID parse a different block still trips the KF701 consensus check
+    monkeypatch.setenv("KF_WIRE_BLOCK", "sixteen")
+    assert int(knobs.get("KF_WIRE_BLOCK")) == 16
+    # strict knobs name themselves even when the raw parser's error
+    # doesn't (a bare "invalid literal for int()" is un-greppable)
+    monkeypatch.setenv("KF_REPLAN_DEMOTE_PATIENCE", "three")
+    with pytest.raises(ValueError, match="KF_REPLAN_DEMOTE_PATIENCE"):
+        knobs.get("KF_REPLAN_DEMOTE_PATIENCE")
+
+
+def test_wire_block_knob_consensus(clusters):
+    """KF701 the hard way: a peer whose resolved KF_WIRE_BLOCK differs
+    gets a named error on every peer, not a short/long-frame hang."""
+    cluster = clusters(2)
+    sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+    assert dict(sessions[0].engine_knobs())["KF_WIRE_BLOCK"] == \
+        str(HostSession.WIRE_BLOCK)
+    real = sessions[1].engine_knobs()
+    sessions[1].engine_knobs = lambda: [
+        (k, "8" if k == "KF_WIRE_BLOCK" else v) for k, v in real
+    ]
+    errs = {}
+
+    def check(r, sess):
+        try:
+            sess.check_knob_consensus()
+            errs[r] = None
+        except RuntimeError as e:
+            errs[r] = str(e)
+
+    _run_on_all([lambda r=r, s=s: check(r, s)
+                 for r, s in enumerate(sessions)])
+    for r in range(2):
+        assert errs[r] is not None and "KF_WIRE_BLOCK" in errs[r], errs
+
+
+def test_unknown_mode_lenient_path_warns_and_runs_exact(clusters, monkeypatch):
+    """The strict knob parser can't be the only guard: session state a
+    version-skewed vote could corrupt must fail SAFE — warn loudly once,
+    audit the bypass, and run exact (never silently quantize)."""
+    monkeypatch.setattr(HostSession, "SEGMENT_MIN_BYTES", 0)
+    monkeypatch.setattr(HostSession, "WIRE_MIN_BYTES", 0)
+    np_ = 2
+    cluster = clusters(np_)
+    sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+    for s in sessions:
+        s._candidates[s.adaptive.active] = (
+            s._candidates[s.adaptive.active][0], "fp8",
+        )
+    rng = np.random.default_rng(53)
+    xs = [rng.normal(0, 1, 4096).astype(np.float32) for _ in range(np_)]
+    want = np.sum(xs, axis=0, dtype=np.float32)
+    outs = {}
+
+    def run(r, sess):
+        out = np.empty_like(xs[r])
+        sess.all_reduce(Workspace(
+            send=xs[r], recv=out, op=ReduceOp.SUM, name="unknown-mode",
+        ))
+        outs[r] = out
+
+    _run_on_all([lambda r=r, s=s: run(r, s) for r, s in enumerate(sessions)])
+    for r in range(np_):
+        np.testing.assert_array_equal(outs[r], want)  # EXACT, not quantized
+    assert all("fp8" in s._unknown_wire_warned for s in sessions)
+    from kungfu_tpu.telemetry import audit
+
+    recs = [r for r in audit.records() if r.kind == "wire_codec_bypass"]
+    assert any(r.detail["reason"] == "unknown_mode" for r in recs)
+
+
+# ---------------------------------------------------------------------------
+# check_precision: the lockstep voted knob + its decision record
+# ---------------------------------------------------------------------------
+
+def test_check_precision_majority_flips_all_minority_does_not(
+    clusters, monkeypatch
+):
+    monkeypatch.setenv("KF_CONFIG_WIRE", "bf16")
+    np_ = 3
+    cluster = clusters(np_)
+    sessions = _sessions(cluster, Strategy.RING_SEGMENTED)
+    from kungfu_tpu.telemetry import decisions as tdecisions
+
+    n0 = len([r for r in tdecisions.get_ledger().records()
+              if r.kind == "precision_switch"])
+
+    # minority (1 of 3): no flip anywhere
+    res = {}
+    _run_on_all([
+        lambda r=r, s=s: res.__setitem__(
+            r, s.check_precision("int8" if r == 0 else None))
+        for r, s in enumerate(sessions)
+    ])
+    assert all(v is None for v in res.values())
+    assert all(s.active_wire_mode() == "bf16" for s in sessions)
+
+    # majority (2 of 3): every peer flips, the dissenter included
+    _run_on_all([
+        lambda r=r, s=s: res.__setitem__(
+            r, s.check_precision("int8" if r < 2 else None,
+                                 trigger="test_vote"))
+        for r, s in enumerate(sessions)
+    ])
+    assert all(v == "int8" for v in res.values())
+    assert all(s.active_wire_mode() == "int8" for s in sessions)
+    recs = [r for r in tdecisions.get_ledger().records()
+            if r.kind == "precision_switch"]
+    assert len(recs) == n0 + np_  # one record per peer
+    assert all(r.trigger == "test_vote" for r in recs[n0:])
+
+    with pytest.raises(ValueError, match="unknown wire mode"):
+        sessions[0].check_precision("fp8")
+
+
+def test_precision_flip_graded_by_ledger(monkeypatch):
+    """The opened precision_switch record closes from measured step
+    times: faster steps -> delivered, slower steps -> regressed (the
+    hostile-flip detection the rollback contract rides on). Pure
+    ledger-level check with synthetic step durations."""
+    monkeypatch.setenv("KF_DECISION_WINDOW", "3")
+    monkeypatch.setenv("KF_DECISION_SETTLE", "0")
+    monkeypatch.setenv("KF_DECISION_PATIENCE", "1")
+    from kungfu_tpu.telemetry import decisions as tdecisions
+
+    tdecisions.reset_ledger()
+    try:
+        ledger = tdecisions.get_ledger()
+        for _ in range(3):
+            ledger.note_step(0.1)  # baseline window
+        rec = tdecisions.open_decision(
+            "precision_switch", peer="p", epoch=0,
+            trigger="noise_scale", signals=None, old="bf16", new="int8",
+        )
+        for _ in range(6):
+            ledger.note_step(0.05)
+        assert rec.verdict == "delivered"
+        sig = ledger.signals()
+        assert "precision_switch" not in (sig.get("decision/regressed") or [])
+
+        for _ in range(3):
+            ledger.note_step(0.05)
+        bad = tdecisions.open_decision(
+            "precision_switch", peer="p", epoch=0,
+            trigger="noise_scale", signals=None, old="int8", new="bf16",
+        )
+        for _ in range(6):
+            ledger.note_step(0.2)
+        assert bad.verdict == "regressed"
+        assert "precision_switch" in ledger.signals()["decision/regressed"]
+    finally:
+        tdecisions.reset_ledger()
+
+
+# ---------------------------------------------------------------------------
+# PrecisionPolicy: thresholds, patience, lockstep, rollback, cooldown
+# ---------------------------------------------------------------------------
+
+class _FakePrecisionSession:
+    """Records check_precision calls; majority is assumed (returns the
+    proposal), so the policy's local state machine is isolated."""
+
+    size = 4
+
+    def __init__(self, mode="bf16"):
+        self.mode = mode
+        self.calls = []
+
+    def active_wire_mode(self):
+        return self.mode
+
+    def check_precision(self, proposal=None, trigger="noise_scale",
+                        signals=None, vote_tag=""):
+        self.calls.append((proposal, trigger))
+        if proposal is not None and proposal != self.mode:
+            self.mode = proposal
+            return proposal
+        return None
+
+
+def _ctx(step, noise_ratio=None, batch=32, regressed=()):
+    from kungfu_tpu.policy import PolicyContext
+
+    ctx = PolicyContext(batch_size=batch)
+    ctx.step = step
+    if noise_ratio is not None:
+        ctx.metrics["monitor/noise_scale"] = noise_ratio * batch
+    if regressed:
+        ctx.metrics["decision/regressed"] = list(regressed)
+    return ctx
+
+
+def test_precision_policy_thresholds_and_patience():
+    from kungfu_tpu.policy import PrecisionPolicy
+
+    sess = _FakePrecisionSession("bf16")
+    pol = PrecisionPolicy(interval_steps=4, patience=2, int8_ratio=8,
+                          int4_ratio=64, cooldown_intervals=0,
+                          session_supplier=lambda: sess)
+    # below int8_ratio: target is the current bf16, never a flip
+    pol.after_step(_ctx(4, noise_ratio=2.0))
+    assert sess.calls[-1] == (None, "noise_scale")
+    # the vote is LOCKSTEP: it runs every interval even with no opinion
+    pol.after_step(_ctx(8, noise_ratio=16.0))    # int8 streak 1 < patience
+    assert sess.calls[-1] == (None, "noise_scale")
+    assert len(sess.calls) == 2
+    # off-interval steps never vote (that would desync the cluster)
+    pol.after_step(_ctx(9, noise_ratio=16.0))
+    assert len(sess.calls) == 2
+    pol.after_step(_ctx(12, noise_ratio=16.0))   # streak 2 -> proposes
+    assert sess.calls[-1] == ("int8", "noise_scale")
+    assert sess.mode == "int8"
+    # ratio >= int4_ratio maps straight to the int4 rung (no
+    # rung-at-a-time ladder), still gated by a fresh patience streak
+    pol.after_step(_ctx(16, noise_ratio=100.0))
+    assert sess.mode == "int8"  # target changed int8 -> int4: streak 1
+    pol.after_step(_ctx(20, noise_ratio=100.0))
+    assert sess.calls[-1] == ("int4", "noise_scale")
+    assert sess.mode == "int4"
+    # broken thresholds rejected at construction
+    with pytest.raises(ValueError):
+        PrecisionPolicy(int8_ratio=64, int4_ratio=8)
+
+
+def test_precision_policy_rollback_and_cooldown():
+    from kungfu_tpu.policy import PrecisionPolicy
+
+    sess = _FakePrecisionSession("bf16")
+    pol = PrecisionPolicy(interval_steps=4, patience=1, int8_ratio=8,
+                          int4_ratio=1e9, cooldown_intervals=2,
+                          session_supplier=lambda: sess)
+    pol.after_step(_ctx(4, noise_ratio=16.0))
+    assert sess.mode == "int8"
+    # the ledger graded our flip hostile: vote straight back
+    ctx = _ctx(8, noise_ratio=16.0, regressed=["precision_switch"])
+    pol.after_step(ctx)
+    assert sess.mode == "bf16"
+    assert sess.calls[-1] == ("bf16", "regression_rollback")
+    # cooldown: the int8 target persists but the proposal is withheld
+    # (regressed stays set — with _flip_old cleared it must NOT re-roll)
+    ctx = _ctx(12, noise_ratio=16.0, regressed=["precision_switch"])
+    pol.after_step(ctx)
+    assert sess.mode == "bf16"
+    assert sess.calls[-1] == (None, "noise_scale")
+    assert ctx.metrics["precision/vote_withheld_cooldown"] >= 1
+    pol.after_step(_ctx(16, noise_ratio=16.0))
+    assert sess.mode == "int8"  # cooldown over, downshift retried
+    # a rollback with no prior flip of ours is never proposed
+    sess2 = _FakePrecisionSession("bf16")
+    pol2 = PrecisionPolicy(interval_steps=4, patience=99,
+                           session_supplier=lambda: sess2)
+    pol2.after_step(_ctx(4, noise_ratio=16.0,
+                         regressed=["precision_switch"]))
+    assert sess2.calls[-1] == (None, "noise_scale")
+
+
+# ---------------------------------------------------------------------------
+# telemetry surfaces: the gauge, the scrape parse, the info rendering
+# ---------------------------------------------------------------------------
+
+def test_cluster_parses_wire_mode_series():
+    from kungfu_tpu.telemetry import cluster as tcluster
+
+    page = (
+        'kungfu_collective_wire_mode{mode="bf16"} 0\n'
+        'kungfu_collective_wire_mode{mode="int8"} 1\n'
+    )
+    parsed = tcluster.parse_worker_page(page)
+    assert parsed["wire_mode"] == "int8"
+    doc = tcluster.parsed_to_doc(parsed)
+    assert tcluster.parsed_from_doc(doc)["wire_mode"] == "int8"
+    assert tcluster.parse_worker_page("")["wire_mode"] is None
+
+
+def test_info_links_renders_wire_precision():
+    from kungfu_tpu.info.__main__ import render_links
+
+    peers = ["a:1", "b:2", "c:3"]
+    edges = {
+        s: {d: {"bw": 100.0 * (1 << 20)} for d in peers if d != s}
+        for s in peers
+    }
+    doc = {
+        "peers": peers, "edges": edges,
+        "ring": {"order": peers, "position": {}, "next": {},
+                 "wire": {p: "int8" for p in peers}},
+    }
+    out = render_links(doc)
+    assert "wire precision: int8" in out
+    # a scrape straddling a flip: divergence rendered loudly
+    doc["ring"]["wire"]["b:2"] = "bf16"
+    out = render_links(doc)
+    line = next(l for l in out.splitlines() if "wire precision" in l)
+    assert "SPLIT" in line and "⚠" in line
+    assert "[1]=bf16" in line
+    # no wire info: no line at all (pre-ISSUE-20 scrapes)
+    doc["ring"].pop("wire")
+    assert "wire precision" not in render_links(doc)
